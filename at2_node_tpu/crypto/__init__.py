@@ -1,0 +1,2 @@
+"""Host-side cryptography: keys, signing, channel auth, and the Verifier
+boundary that routes signature checks to CPU or the TPU batch kernel."""

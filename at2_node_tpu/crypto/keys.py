@@ -1,0 +1,95 @@
+"""ed25519 signing keys and X25519 network (channel) keys.
+
+Equivalent of the reference's `drop::crypto::sign::{KeyPair, PublicKey,
+PrivateKey}` (used at `/root/reference/src/lib.rs:5`,
+`/root/reference/src/client.rs:77-78`) and
+`drop::crypto::key::exchange::KeyPair` (used at
+`/root/reference/src/bin/server/rpc.rs:14-17,80`).
+
+Host-side single signatures use the `cryptography` library (OpenSSL);
+the batched hot path lives on TPU (`at2_node_tpu.ops.ed25519`). Keys are
+hex-encoded in config files, matching the reference's `#[serde(with =
+"hex")]` (`/root/reference/src/bin/server/config.rs:14-17`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519, x25519
+
+_RAW = serialization.Encoding.Raw
+_RAW_PUB = serialization.PublicFormat.Raw
+_RAW_PRIV = serialization.PrivateFormat.Raw
+_NOENC = serialization.NoEncryption()
+
+
+@dataclass(frozen=True)
+class SignKeyPair:
+    """ed25519 keypair; signs the canonical byte form of messages."""
+
+    private_bytes: bytes  # 32-byte seed
+
+    @staticmethod
+    def random() -> "SignKeyPair":
+        key = ed25519.Ed25519PrivateKey.generate()
+        return SignKeyPair(key.private_bytes(_RAW, _RAW_PRIV, _NOENC))
+
+    @staticmethod
+    def from_hex(s: str) -> "SignKeyPair":
+        return SignKeyPair(bytes.fromhex(s))
+
+    def to_hex(self) -> str:
+        return self.private_bytes.hex()
+
+    @property
+    def public(self) -> bytes:
+        key = ed25519.Ed25519PrivateKey.from_private_bytes(self.private_bytes)
+        return key.public_key().public_bytes(_RAW, _RAW_PUB)
+
+    def sign(self, message: bytes) -> bytes:
+        key = ed25519.Ed25519PrivateKey.from_private_bytes(self.private_bytes)
+        return key.sign(message)
+
+
+def verify_one(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    """Single CPU ed25519 verification (the reference's per-message path;
+    the TPU batch path is `ops.ed25519.verify_batch`)."""
+    try:
+        ed25519.Ed25519PublicKey.from_public_bytes(public_key).verify(
+            signature, message
+        )
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+@dataclass(frozen=True)
+class ExchangeKeyPair:
+    """X25519 keypair authenticating node<->node channels (drop's
+    `key::exchange::KeyPair`, `/root/reference/src/bin/server/config.rs:16`)."""
+
+    private_bytes: bytes
+
+    @staticmethod
+    def random() -> "ExchangeKeyPair":
+        key = x25519.X25519PrivateKey.generate()
+        return ExchangeKeyPair(key.private_bytes(_RAW, _RAW_PRIV, _NOENC))
+
+    @staticmethod
+    def from_hex(s: str) -> "ExchangeKeyPair":
+        return ExchangeKeyPair(bytes.fromhex(s))
+
+    def to_hex(self) -> str:
+        return self.private_bytes.hex()
+
+    @property
+    def public(self) -> bytes:
+        key = x25519.X25519PrivateKey.from_private_bytes(self.private_bytes)
+        return key.public_key().public_bytes(_RAW, _RAW_PUB)
+
+    def exchange(self, peer_public: bytes) -> bytes:
+        key = x25519.X25519PrivateKey.from_private_bytes(self.private_bytes)
+        return key.exchange(x25519.X25519PublicKey.from_public_bytes(peer_public))

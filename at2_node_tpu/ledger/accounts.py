@@ -1,0 +1,131 @@
+"""Single-writer accounts actor owning the ledger map.
+
+Equivalent of the reference's `Accounts`/`AccountsHandler` actor
+(`/root/reference/src/bin/server/accounts/mod.rs:28-214`): all mutations are
+serialized through one asyncio task consuming a command queue (the tokio
+``mpsc::channel(32)`` + oneshot pattern at `accounts/mod.rs:126-153`),
+preserving per-account linearizability without locks.
+
+Observable semantics reproduced exactly (pinned by the reference's tests at
+`accounts/mod.rs:216-301`):
+
+* unknown accounts read as fresh (balance 100 000, sequence 0)
+  (`accounts/mod.rs:155-163,207-213`);
+* self-transfer is a zero-amount debit: bumps the sequence, keeps the
+  balance (`accounts/mod.rs:175-182`);
+* a transfer debits then credits; the sender's account state is persisted
+  even when the debit fails, so a failed overdraft still consumes the
+  sender's sequence number (`accounts/mod.rs:184-196`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, Tuple
+
+from .account import Account, AccountException
+
+logger = logging.getLogger(__name__)
+
+_QUEUE_DEPTH = 32  # accounts/mod.rs:127
+
+
+class AccountModificationError(Exception):
+    """Wraps an account-level failure; the delivery loop retries only this
+    error kind (gap filling, `/root/reference/src/bin/server/rpc.rs:195-205`)."""
+
+    def __init__(self, source: AccountException):
+        super().__init__(f"account modification: {source}")
+        self.source = source
+
+
+class Accounts:
+    """Client handle to the single-writer ledger actor."""
+
+    def __init__(self) -> None:
+        self._ledger: Dict[bytes, Account] = {}
+        self._queue: asyncio.Queue[
+            Tuple[Callable[[], object], asyncio.Future]
+        ] = asyncio.Queue(_QUEUE_DEPTH)
+        self._closed = False
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            op, fut = await self._queue.get()
+            if fut.cancelled():
+                continue
+            try:
+                fut.set_result(op())
+            except Exception as exc:  # delivered to the caller, actor lives on
+                fut.set_exception(exc)
+
+    async def _call(self, op: Callable[[], object]) -> object:
+        if self._closed:
+            raise RuntimeError("accounts actor is closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((op, fut))
+        return await fut
+
+    def close(self) -> None:
+        """Stop the actor; fail queued callers instead of hanging them."""
+        self._closed = True
+        self._task.cancel()
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(RuntimeError("accounts actor is closed"))
+
+    async def get_balance(self, user: bytes) -> int:
+        return await self._call(lambda: self._get_balance(user))  # type: ignore[return-value]
+
+    async def get_last_sequence(self, user: bytes) -> int:
+        return await self._call(lambda: self._get_last_sequence(user))  # type: ignore[return-value]
+
+    async def transfer(
+        self, sender: bytes, sender_sequence: int, receiver: bytes, amount: int
+    ) -> None:
+        await self._call(
+            lambda: self._transfer(sender, sender_sequence, receiver, amount)
+        )
+
+    # -- actor-side ops (only ever run on the single writer task) --
+
+    def _get_balance(self, user: bytes) -> int:
+        account = self._ledger.get(user)
+        return account.balance if account is not None else Account().balance
+
+    def _get_last_sequence(self, user: bytes) -> int:
+        account = self._ledger.get(user)
+        return account.last_sequence if account is not None else 0
+
+    def _transfer(
+        self, sender: bytes, sender_sequence: int, receiver: bytes, amount: int
+    ) -> None:
+        if sender == receiver:
+            logger.warning("transfer to itself: %s", sender.hex())
+            account = self._ledger.setdefault(sender, Account())
+            try:
+                account.debit(sender_sequence, 0)
+            except AccountException as exc:
+                raise AccountModificationError(exc) from exc
+            return
+
+        sender_account = self._ledger.get(sender) or Account()
+        receiver_account = self._ledger.get(receiver) or Account()
+
+        try:
+            sender_account.debit(sender_sequence, amount)
+        except AccountException as exc:
+            # Persist the (sequence-consumed) sender state even on failure
+            # (accounts/mod.rs:190-194).
+            self._ledger[sender] = sender_account
+            raise AccountModificationError(exc) from exc
+        self._ledger[sender] = sender_account
+
+        try:
+            receiver_account.credit(amount)
+        except AccountException as exc:
+            raise AccountModificationError(exc) from exc
+        self._ledger[receiver] = receiver_account

@@ -1,0 +1,70 @@
+"""Recent-transactions ring actor (last 10, Pending/Success/Failure).
+
+Equivalent of the reference's `RecentTransactions` actor
+(`/root/reference/src/bin/server/recent_transactions.rs:38-201`):
+
+* capacity-10 ring (`recent_transactions.rs:7`), oldest evicted
+  (`:173-177`);
+* ``put`` stamps the current UTC time, starts Pending, and is a NOP when a
+  transaction with the same (sender, sequence) is already present
+  (`:149-180`);
+* ``update`` finds the latest matching (sender, sequence) and flips its
+  state; NOP when absent because a transaction may resolve after eviction
+  (`:182-196`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import datetime
+from collections import deque
+from typing import Deque, List
+
+from ..types import FullTransaction, ThinTransaction, TransactionState
+
+LATEST_TRANSACTIONS_MAX_SIZE = 10  # recent_transactions.rs:7
+
+
+class RecentTransactions:
+    """Single-writer actor over the last-N transactions ring."""
+
+    def __init__(self) -> None:
+        self._ring: Deque[FullTransaction] = deque()
+        self._lock = asyncio.Lock()
+
+    async def put(
+        self, sender: bytes, sender_sequence: int, thin: ThinTransaction
+    ) -> None:
+        async with self._lock:
+            for tx in self._ring:
+                if tx.sender_sequence == sender_sequence and tx.sender == sender:
+                    return
+            if len(self._ring) == LATEST_TRANSACTIONS_MAX_SIZE:
+                self._ring.popleft()
+            self._ring.append(
+                FullTransaction(
+                    timestamp=datetime.datetime.now(datetime.timezone.utc),
+                    sender=sender,
+                    sender_sequence=sender_sequence,
+                    recipient=thin.recipient,
+                    amount=thin.amount,
+                    state=TransactionState.PENDING,
+                )
+            )
+
+    async def update(
+        self, sender: bytes, sender_sequence: int, state: TransactionState
+    ) -> None:
+        async with self._lock:
+            for tx in reversed(self._ring):
+                if tx.sender_sequence == sender_sequence and tx.sender == sender:
+                    tx.state = state
+                    return
+
+    async def get_all(self) -> List[FullTransaction]:
+        async with self._lock:
+            # Deep snapshot, like the reference's `self.0.clone()`
+            # (recent_transactions.rs:198-200): later state updates must not
+            # mutate an already-returned list, nor callers corrupt the ring.
+            return [dataclasses.replace(tx) for tx in self._ring]

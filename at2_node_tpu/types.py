@@ -1,0 +1,94 @@
+"""Shared transaction types and canonical wire serialization.
+
+TPU-native re-design of the reference's shared types
+(`/root/reference/src/lib.rs:17-50`): ``ThinTransaction`` (the payload the
+sender signs), ``TransactionState`` and ``FullTransaction`` (what the
+recent-transactions ring stores).
+
+Canonical byte layout
+---------------------
+The reference signs/ships bincode-serialized Rust structs
+(`/root/reference/src/client.rs:77-87`). bincode compatibility is not
+required — the whole stack is replaced — but client and server must agree
+on a canonical layout, so we define one explicitly:
+
+* public keys / signatures: raw bytes (32 / 64), no length prefix when the
+  field width is fixed;
+* integers: little-endian fixed width (u32 for sequence numbers mirroring
+  ``sieve::Sequence`` = u32 at `/root/reference/src/at2.proto:13`, u64 for
+  amounts);
+* the *signed* form of a ``ThinTransaction`` is ``recipient(32) ||
+  amount(8, LE)`` — note that like the reference the sequence number is NOT
+  part of the signed struct (`/root/reference/src/client.rs:77-78`); it is
+  bound to the payload by the broadcast layer.
+"""
+
+from __future__ import annotations
+
+import datetime
+import enum
+import struct
+from dataclasses import dataclass
+
+Sequence = int  # u32, mirrors sieve::Sequence (at2.proto:13)
+
+PUBLIC_KEY_LEN = 32
+SIGNATURE_LEN = 64
+
+
+class TransactionState(enum.Enum):
+    """Processing status of a transaction (`lib.rs:26-33`)."""
+
+    PENDING = 0
+    SUCCESS = 1
+    FAILURE = 2
+
+
+@dataclass(frozen=True)
+class ThinTransaction:
+    """The signed wire payload: who gets how much (`lib.rs:15-24`)."""
+
+    recipient: bytes  # 32-byte ed25519 public key
+    amount: int  # u64
+
+    def __post_init__(self) -> None:
+        if len(self.recipient) != PUBLIC_KEY_LEN:
+            raise ValueError("recipient must be a 32-byte public key")
+        if not 0 <= self.amount < 1 << 64:
+            raise ValueError("amount must fit in u64")
+
+    def signing_bytes(self) -> bytes:
+        """Canonical byte form the sender signs (`client.rs:77-78`)."""
+        return self.recipient + struct.pack("<Q", self.amount)
+
+
+@dataclass
+class FullTransaction:
+    """A transaction as committed to the recent ring (`lib.rs:37-50`)."""
+
+    timestamp: datetime.datetime
+    sender: bytes  # 32-byte ed25519 public key
+    sender_sequence: Sequence
+    recipient: bytes
+    amount: int
+    state: TransactionState
+
+
+def rfc3339(ts: datetime.datetime) -> str:
+    """RFC 3339 timestamp string, like chrono's ``to_rfc3339``
+    (`/root/reference/src/bin/server/rpc.rs:327`). Naive datetimes are
+    taken as UTC so the output always carries an offset."""
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=datetime.timezone.utc)
+    return ts.isoformat()
+
+
+def parse_rfc3339(s: str) -> datetime.datetime:
+    """Inverse of :func:`rfc3339` (`/root/reference/src/client.rs:129-131`).
+
+    Accepts the ``Z`` suffix explicitly so peers emitting the canonical
+    RFC 3339 form parse on every supported Python version.
+    """
+    if s.endswith(("Z", "z")):
+        s = s[:-1] + "+00:00"
+    return datetime.datetime.fromisoformat(s)

@@ -1,0 +1,18 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; shardings are validated on a
+virtual CPU mesh (`--xla_force_host_platform_device_count`), mirroring how
+the driver dry-runs the multi-chip path. Must run before `import jax`.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,227 @@
+"""Ledger semantics tests.
+
+Ports of the reference's own unit tests, which pin the observable ledger
+quirks (`/root/reference/src/bin/server/accounts/account.rs:56-91`,
+`accounts/mod.rs:216-301`, `recent_transactions.rs:203-249`).
+"""
+
+import asyncio
+
+import pytest
+
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.ledger import (
+    Account,
+    AccountModificationError,
+    Accounts,
+    INITIAL_BALANCE,
+    RecentTransactions,
+)
+from at2_node_tpu.ledger.account import AccountError, AccountException
+from at2_node_tpu.types import ThinTransaction, TransactionState
+
+
+# -- Account state machine (account.rs:56-91) --
+
+
+def test_debit_too_much_fails_but_bumps_sequence():
+    account = Account()
+    old_seq = account.last_sequence
+    with pytest.raises(AccountException) as exc:
+        account.debit(1, INITIAL_BALANCE + 1)
+    assert exc.value.kind == AccountError.UNDERFLOW
+    assert account.last_sequence > old_seq
+    assert account.balance == INITIAL_BALANCE
+
+
+def test_debit_increases_sequence():
+    account = Account()
+    old_seq = account.last_sequence
+    account.debit(1, 1)
+    assert account.last_sequence > old_seq
+
+
+def test_credit_doesnt_change_sequence():
+    account = Account()
+    old_seq = account.last_sequence
+    account.credit(1)
+    assert account.last_sequence == old_seq
+
+
+def test_debit_requires_consecutive_sequence():
+    account = Account()
+    with pytest.raises(AccountException) as exc:
+        account.debit(2, 1)
+    assert exc.value.kind == AccountError.INCONSECUTIVE_SEQUENCE
+    assert account.last_sequence == 0
+
+
+def test_credit_overflow():
+    account = Account()
+    with pytest.raises(AccountException) as exc:
+        account.credit((1 << 64) - 1)
+    assert exc.value.kind == AccountError.OVERFLOW
+
+
+# -- Accounts actor (accounts/mod.rs:216-301) --
+
+
+async def _balance_and_sequence(accounts, user):
+    return await accounts.get_balance(user), await accounts.get_last_sequence(user)
+
+
+def test_new_account_is_the_same_as_unknown_account():
+    async def run():
+        accounts = Accounts()
+        user = SignKeyPair.random().public
+        balance, sequence = await _balance_and_sequence(accounts, user)
+        fresh = Account()
+        assert balance == fresh.balance
+        assert sequence == fresh.last_sequence
+        accounts.close()
+
+    asyncio.run(run())
+
+
+def test_transfer_to_themselves_increments_sequence_and_keeps_balance():
+    async def run():
+        accounts = Accounts()
+        user = SignKeyPair.random().public
+        balance0, seq0 = await _balance_and_sequence(accounts, user)
+        await accounts.transfer(user, 1, user, 10)
+        balance1, seq1 = await _balance_and_sequence(accounts, user)
+        assert balance0 == balance1
+        assert seq0 < seq1
+        accounts.close()
+
+    asyncio.run(run())
+
+
+def test_transfer_too_much_fails_and_increases_sequence():
+    async def run():
+        accounts = Accounts()
+        first = SignKeyPair.random().public
+        second = SignKeyPair.random().public
+        fb0, fs0 = await _balance_and_sequence(accounts, first)
+        sb0, ss0 = await _balance_and_sequence(accounts, second)
+        with pytest.raises(AccountModificationError):
+            await accounts.transfer(first, 1, second, fb0 + 1)
+        fb1, fs1 = await _balance_and_sequence(accounts, first)
+        sb1, ss1 = await _balance_and_sequence(accounts, second)
+        assert fb0 == fb1
+        assert fs0 < fs1
+        assert sb0 == sb1
+        assert ss0 == ss1
+        accounts.close()
+
+    asyncio.run(run())
+
+
+def test_transfer_conserves_total_balance():
+    async def run():
+        accounts = Accounts()
+        alice = SignKeyPair.random().public
+        bob = SignKeyPair.random().public
+        await accounts.transfer(alice, 1, bob, 1000)
+        assert await accounts.get_balance(alice) == INITIAL_BALANCE - 1000
+        assert await accounts.get_balance(bob) == INITIAL_BALANCE + 1000
+        accounts.close()
+
+    asyncio.run(run())
+
+
+def test_transfer_sequence_gap_is_retryable_error():
+    async def run():
+        accounts = Accounts()
+        alice = SignKeyPair.random().public
+        bob = SignKeyPair.random().public
+        with pytest.raises(AccountModificationError) as exc:
+            await accounts.transfer(alice, 2, bob, 1)
+        assert exc.value.source.kind == AccountError.INCONSECUTIVE_SEQUENCE
+        # gap filled: now 1 then 2 work
+        await accounts.transfer(alice, 1, bob, 1)
+        await accounts.transfer(alice, 2, bob, 1)
+        assert await accounts.get_last_sequence(alice) == 2
+        accounts.close()
+
+    asyncio.run(run())
+
+
+# -- RecentTransactions ring (recent_transactions.rs:203-249) --
+
+
+def test_put_transactions_show_in_get_all():
+    async def run():
+        recent = RecentTransactions()
+        sender = SignKeyPair.random().public
+        recipient = SignKeyPair.random().public
+        txs = [
+            ThinTransaction(recipient=recipient, amount=10),
+            ThinTransaction(recipient=sender, amount=3),
+        ]
+        for seq, thin in enumerate(txs, start=1):
+            await recent.put(sender, seq, thin)
+
+        got = await recent.get_all()
+        assert len(got) == len(txs)
+        for seq, (thin, full) in enumerate(zip(txs, got), start=1):
+            assert full.sender == sender
+            assert full.sender_sequence == seq
+            assert full.amount == thin.amount
+            assert full.recipient == thin.recipient
+            assert full.state == TransactionState.PENDING
+
+    asyncio.run(run())
+
+
+def test_put_dedups_by_sender_and_sequence():
+    async def run():
+        recent = RecentTransactions()
+        sender = SignKeyPair.random().public
+        thin = ThinTransaction(recipient=sender, amount=1)
+        await recent.put(sender, 1, thin)
+        await recent.put(sender, 1, thin)
+        assert len(await recent.get_all()) == 1
+
+    asyncio.run(run())
+
+
+def test_ring_caps_at_ten_and_update_missing_is_nop():
+    async def run():
+        recent = RecentTransactions()
+        sender = SignKeyPair.random().public
+        thin = ThinTransaction(recipient=sender, amount=1)
+        for seq in range(1, 13):
+            await recent.put(sender, seq, thin)
+        got = await recent.get_all()
+        assert len(got) == 10
+        assert got[0].sender_sequence == 3  # oldest two evicted
+
+        # updating an evicted (or never-seen) tx is a NOP
+        await recent.update(sender, 1, TransactionState.SUCCESS)
+        await recent.update(sender, 5, TransactionState.SUCCESS)
+        got = await recent.get_all()
+        states = {tx.sender_sequence: tx.state for tx in got}
+        assert states[5] == TransactionState.SUCCESS
+        assert states[4] == TransactionState.PENDING
+
+    asyncio.run(run())
+
+
+# -- shared types --
+
+
+def test_signing_bytes_layout():
+    recipient = bytes(range(32))
+    thin = ThinTransaction(recipient=recipient, amount=5)
+    assert thin.signing_bytes() == recipient + (5).to_bytes(8, "little")
+
+
+def test_sign_verify_roundtrip():
+    from at2_node_tpu.crypto.keys import verify_one
+
+    keypair = SignKeyPair.random()
+    thin = ThinTransaction(recipient=SignKeyPair.random().public, amount=42)
+    sig = keypair.sign(thin.signing_bytes())
+    assert verify_one(keypair.public, thin.signing_bytes(), sig)
+    assert not verify_one(keypair.public, b"other message", sig)
