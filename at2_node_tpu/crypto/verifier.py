@@ -1,0 +1,222 @@
+"""The Verifier boundary: pluggable CPU / TPU-batch signature verification.
+
+This is the plugin seam BASELINE.json's north star names: the reference
+checks each broadcast message's ed25519 signature synchronously on CPU
+inside its dependency crates; here every check goes through an async
+``Verifier`` so the node can transparently swap:
+
+* :class:`CpuVerifier` — per-signature verification (OpenSSL via
+  `cryptography`) on a thread pool; the parity baseline.
+* :class:`TpuBatchVerifier` — accumulates requests, pads to a fixed batch
+  bucket, and dispatches ONE XLA call for the whole batch. Adaptive flush:
+  a batch goes out when it reaches ``batch_size`` OR when the oldest
+  request has waited ``max_delay`` (whichever first), bounding the latency
+  a consensus round pays for batching (SURVEY.md §7 hard part #2).
+
+Selected by node config: ``verifier = "cpu" | "tpu"`` (SURVEY.md §5
+config addition).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import List, Protocol, Sequence, Tuple
+
+import numpy as np
+
+from .keys import verify_one
+
+
+def _default_buckets() -> tuple:
+    from ..ops.ed25519 import BUCKETS
+
+    return BUCKETS
+
+
+DEFAULT_BUCKETS = None  # resolved lazily to ops.ed25519.BUCKETS
+
+
+class Verifier(Protocol):
+    """Anything that can check ed25519 signatures asynchronously."""
+
+    async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        ...
+
+    async def verify_many(
+        self, items: Sequence[Tuple[bytes, bytes, bytes]]
+    ) -> List[bool]:
+        ...
+
+    async def close(self) -> None:
+        ...
+
+
+class CpuVerifier:
+    """Per-signature CPU verification on a thread pool (the reference's
+    execution model: `num_cpus` broadcast workers each verifying inline,
+    `/root/reference/src/bin/server/rpc.rs:125`)."""
+
+    def __init__(self, max_workers: int | None = None) -> None:
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+
+    async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._pool, verify_one, public_key, message, signature
+        )
+
+    async def verify_many(
+        self, items: Sequence[Tuple[bytes, bytes, bytes]]
+    ) -> List[bool]:
+        loop = asyncio.get_running_loop()
+        futs = [
+            loop.run_in_executor(self._pool, verify_one, pk, msg, sig)
+            for pk, msg, sig in items
+        ]
+        return list(await asyncio.gather(*futs))
+
+    async def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+@dataclass
+class _Pending:
+    public_key: bytes
+    message: bytes
+    signature: bytes
+    future: asyncio.Future
+    enqueued_at: float
+
+
+class TpuBatchVerifier:
+    """Accumulate -> pad to bucket -> one XLA dispatch -> resolve futures.
+
+    The device call runs on a dedicated executor thread so the event loop
+    (gRPC handlers, broadcast state machines) never blocks on device
+    latency; results come back as resolved futures.
+    """
+
+    def __init__(
+        self,
+        batch_size: int = 256,
+        max_delay: float = 0.002,
+        buckets: Sequence[int] | None = None,
+    ) -> None:
+        self.batch_size = batch_size
+        self.max_delay = max_delay
+        if buckets is None:
+            buckets = _default_buckets()
+        self.buckets = tuple(sorted(set(buckets) | {batch_size}))
+        self._queue: List[_Pending] = []
+        self._wakeup = asyncio.Event()
+        self._device_pool = ThreadPoolExecutor(max_workers=1)
+        self._closed = False
+        self._flusher = asyncio.get_running_loop().create_task(self._flush_loop())
+        # Stats for observability (SURVEY.md §5: per-stage counters)
+        self.batches_dispatched = 0
+        self.signatures_verified = 0
+        self.total_padding = 0
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.append(
+            _Pending(public_key, message, signature, fut, time.monotonic())
+        )
+        if len(self._queue) >= self.batch_size:
+            self._wakeup.set()
+        return await fut
+
+    async def verify_many(
+        self, items: Sequence[Tuple[bytes, bytes, bytes]]
+    ) -> List[bool]:
+        return list(
+            await asyncio.gather(*(self.verify(pk, m, s) for pk, m, s in items))
+        )
+
+    async def _flush_loop(self) -> None:
+        while not self._closed:
+            if not self._queue:
+                self._wakeup.clear()
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=0.1)
+                except asyncio.TimeoutError:
+                    continue
+            # wait for a full batch or until the oldest request expires
+            while (
+                len(self._queue) < self.batch_size
+                and self._queue
+                and (time.monotonic() - self._queue[0].enqueued_at) < self.max_delay
+            ):
+                self._wakeup.clear()
+                remaining = self.max_delay - (
+                    time.monotonic() - self._queue[0].enqueued_at
+                )
+                try:
+                    await asyncio.wait_for(
+                        self._wakeup.wait(), timeout=max(remaining, 0.0001)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            if not self._queue:
+                continue
+            batch, self._queue = (
+                self._queue[: self.batch_size],
+                self._queue[self.batch_size :],
+            )
+            await self._dispatch(batch)
+
+    async def _dispatch(self, batch: List[_Pending]) -> None:
+        from ..ops import ed25519 as kernel
+
+        bucket = self._bucket_for(len(batch))
+        loop = asyncio.get_running_loop()
+
+        def run() -> np.ndarray:
+            return kernel.verify_batch(
+                [p.public_key for p in batch],
+                [p.message for p in batch],
+                [p.signature for p in batch],
+                batch_size=bucket,
+            )
+
+        try:
+            results = await loop.run_in_executor(self._device_pool, run)
+        except Exception as exc:
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        self.batches_dispatched += 1
+        self.signatures_verified += len(batch)
+        self.total_padding += bucket - len(batch)
+        for p, ok in zip(batch, results):
+            if not p.future.done():
+                p.future.set_result(bool(ok))
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wakeup.set()
+        self._flusher.cancel()
+        for p in self._queue:
+            if not p.future.done():
+                p.future.set_exception(RuntimeError("verifier closed"))
+        self._queue.clear()
+        self._device_pool.shutdown(wait=False, cancel_futures=True)
+
+
+def make_verifier(kind: str, **kwargs) -> Verifier:
+    """Config-driven verifier selection (``verifier = "cpu" | "tpu"``)."""
+    if kind == "cpu":
+        return CpuVerifier(**kwargs)
+    if kind == "tpu":
+        return TpuBatchVerifier(**kwargs)
+    raise ValueError(f"unknown verifier kind: {kind!r}")

@@ -1,10 +1,13 @@
-"""Single-writer accounts actor owning the ledger map.
+"""Single-writer accounts guard owning the ledger map.
 
 Equivalent of the reference's `Accounts`/`AccountsHandler` actor
-(`/root/reference/src/bin/server/accounts/mod.rs:28-214`): all mutations are
-serialized through one asyncio task consuming a command queue (the tokio
-``mpsc::channel(32)`` + oneshot pattern at `accounts/mod.rs:126-153`),
-preserving per-account linearizability without locks.
+(`/root/reference/src/bin/server/accounts/mod.rs:28-214`). The reference
+needs a tokio task + mpsc/oneshot channels because its mutations come from
+many OS threads; in a single-threaded asyncio node the same single-writer
+linearizability falls out of serializing all mutations through one
+``asyncio.Lock`` critical section — no channel machinery, no close-time
+future bookkeeping (the sibling :class:`RecentTransactions` uses the same
+pattern).
 
 Observable semantics reproduced exactly (pinned by the reference's tests at
 `accounts/mod.rs:216-301`):
@@ -22,13 +25,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Awaitable, Callable, Dict, Tuple
+from typing import Dict
 
 from .account import Account, AccountException
 
 logger = logging.getLogger(__name__)
-
-_QUEUE_DEPTH = 32  # accounts/mod.rs:127
 
 
 class AccountModificationError(Exception):
@@ -41,64 +42,30 @@ class AccountModificationError(Exception):
 
 
 class Accounts:
-    """Client handle to the single-writer ledger actor."""
+    """Async facade over the ledger; all mutations serialize on one lock."""
 
     def __init__(self) -> None:
         self._ledger: Dict[bytes, Account] = {}
-        self._queue: asyncio.Queue[
-            Tuple[Callable[[], object], asyncio.Future]
-        ] = asyncio.Queue(_QUEUE_DEPTH)
-        self._closed = False
-        self._task = asyncio.get_running_loop().create_task(self._run())
-
-    async def _run(self) -> None:
-        while True:
-            op, fut = await self._queue.get()
-            if fut.cancelled():
-                continue
-            try:
-                fut.set_result(op())
-            except Exception as exc:  # delivered to the caller, actor lives on
-                fut.set_exception(exc)
-
-    async def _call(self, op: Callable[[], object]) -> object:
-        if self._closed:
-            raise RuntimeError("accounts actor is closed")
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((op, fut))
-        return await fut
+        self._lock = asyncio.Lock()
 
     def close(self) -> None:
-        """Stop the actor; fail queued callers instead of hanging them."""
-        self._closed = True
-        self._task.cancel()
-        while not self._queue.empty():
-            _, fut = self._queue.get_nowait()
-            if not fut.done():
-                fut.set_exception(RuntimeError("accounts actor is closed"))
+        """Kept for API symmetry with heavier backends; nothing to stop."""
 
     async def get_balance(self, user: bytes) -> int:
-        return await self._call(lambda: self._get_balance(user))  # type: ignore[return-value]
+        async with self._lock:
+            account = self._ledger.get(user)
+            return account.balance if account is not None else Account().balance
 
     async def get_last_sequence(self, user: bytes) -> int:
-        return await self._call(lambda: self._get_last_sequence(user))  # type: ignore[return-value]
+        async with self._lock:
+            account = self._ledger.get(user)
+            return account.last_sequence if account is not None else 0
 
     async def transfer(
         self, sender: bytes, sender_sequence: int, receiver: bytes, amount: int
     ) -> None:
-        await self._call(
-            lambda: self._transfer(sender, sender_sequence, receiver, amount)
-        )
-
-    # -- actor-side ops (only ever run on the single writer task) --
-
-    def _get_balance(self, user: bytes) -> int:
-        account = self._ledger.get(user)
-        return account.balance if account is not None else Account().balance
-
-    def _get_last_sequence(self, user: bytes) -> int:
-        account = self._ledger.get(user)
-        return account.last_sequence if account is not None else 0
+        async with self._lock:
+            self._transfer(sender, sender_sequence, receiver, amount)
 
     def _transfer(
         self, sender: bytes, sender_sequence: int, receiver: bytes, amount: int

@@ -1,0 +1,164 @@
+"""Batched ed25519 signature verification: host prep + TPU kernel.
+
+This is the north-star component (BASELINE.json `north_star`): the
+reference verifies every payload/Echo/Ready signature one-by-one on CPU
+inside its broadcast crates (`/root/reference/technical.md:7-12`
+[dep-inferred]); here whole batches are verified in ONE XLA dispatch.
+
+Split of work:
+
+* **Host (numpy + hashlib)**: SHA-512 challenge ``h = H(R || A || M) mod
+  L``, scalar range check ``S < L``, and 4-bit window decomposition of both
+  scalars. Hashing short messages is ~µs-cheap and sequential-friendly;
+  the elliptic-curve math (~4000 field muls per signature) is what needs
+  the TPU.
+* **TPU (one jit-compiled graph per batch bucket)**: decompress A and R,
+  Straus interleaved double-scalar multiplication computing
+  ``[S]B + [h](-A)``, projective comparison against R — the full
+  cofactorless RFC 8032 check ``[S]B == R + [h]A``.
+
+Batch shapes are fixed per bucket (pad + validity mask) so XLA compiles
+once per bucket and never recompiles on traffic jitter.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import edwards as ed
+from . import field as fe
+
+# ed25519 group order L = 2^252 + 27742317777372353535851937790883648493
+L = (1 << 252) + 27742317777372353535851937790883648493
+
+N_WINDOWS = 64  # 256 bits / 4-bit windows
+
+# Fixed batch buckets: one compiled XLA program per size; every batch is
+# padded up to a bucket so traffic jitter never triggers a recompile.
+BUCKETS = (64, 256, 1024, 4096, 8192)
+
+
+def bucket_for(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return BUCKETS[-1]
+
+
+def _windows_msb_first(scalars_le: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 little-endian scalars -> (B, 64) int32 4-bit windows,
+    most-significant window first (vectorized nibble split)."""
+    lo = (scalars_le & 0x0F).astype(np.int32)
+    hi = (scalars_le >> 4).astype(np.int32)
+    # LSB-first interleave: [lo0, hi0, lo1, hi1, ...] then reverse
+    inter = np.empty((scalars_le.shape[0], N_WINDOWS), dtype=np.int32)
+    inter[:, 0::2] = lo
+    inter[:, 1::2] = hi
+    return inter[:, ::-1].copy()
+
+
+def prepare_batch(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    batch_size: int | None = None,
+):
+    """Host-side batch preparation.
+
+    Returns ``(a_bytes, r_bytes, s_windows, h_windows, valid)`` numpy
+    arrays, padded to ``batch_size`` when given. ``valid`` is False for
+    malformed inputs (bad lengths, S >= L) and for padding lanes; the
+    kernel ANDs it into its result, so padding verifies as False without
+    branching.
+    """
+    n = len(public_keys)
+    size = batch_size if batch_size is not None else n
+    if n > size:
+        raise ValueError(f"batch of {n} exceeds bucket size {size}")
+
+    a_bytes = np.zeros((size, 32), dtype=np.uint8)
+    r_bytes = np.zeros((size, 32), dtype=np.uint8)
+    s_le = np.zeros((size, 32), dtype=np.uint8)
+    h_le = np.zeros((size, 32), dtype=np.uint8)
+    valid = np.zeros((size,), dtype=bool)
+
+    for i in range(n):
+        pk, msg, sig = public_keys[i], messages[i], signatures[i]
+        if len(pk) != 32 or len(sig) != 64:
+            continue
+        r, s_raw = sig[:32], sig[32:]
+        s = int.from_bytes(s_raw, "little")
+        if s >= L:  # malleability / range check (RFC 8032 §5.1.7)
+            continue
+        h = (
+            int.from_bytes(hashlib.sha512(r + pk + msg).digest(), "little") % L
+        )
+        a_bytes[i] = np.frombuffer(pk, dtype=np.uint8)
+        r_bytes[i] = np.frombuffer(r, dtype=np.uint8)
+        s_le[i] = np.frombuffer(s_raw, dtype=np.uint8)
+        h_le[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
+        valid[i] = True
+
+    return (
+        a_bytes,
+        r_bytes,
+        _windows_msb_first(s_le),
+        _windows_msb_first(h_le),
+        valid,
+    )
+
+
+def verify_kernel(
+    a_bytes: jnp.ndarray,
+    r_bytes: jnp.ndarray,
+    s_windows: jnp.ndarray,
+    h_windows: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """The jittable batched verification graph: (B,) bool validity bitmap.
+
+    Checks ``[S]B + [h](-A) == R`` — equivalent to the RFC 8032
+    cofactorless equation ``[S]B == R + [h]A`` — entirely with masked
+    vector ops: an invalid lane (bad point encoding, padding) flows
+    through as the base point and is squelched by its mask bit.
+    """
+    a_point, a_ok = ed.decompress(a_bytes)
+    r_point, r_ok = ed.decompress(r_bytes)
+    q = ed.double_scalar_mul_vs_base(ed.negate(a_point), h_windows, s_windows)
+    matches = ed.equals_affine(q, r_point[..., ed.X, :], r_point[..., ed.Y, :])
+    return valid & a_ok & r_ok & matches
+
+
+_verify_jit = jax.jit(verify_kernel)
+
+
+def verify_batch(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    batch_size: int | None = None,
+) -> np.ndarray:
+    """End-to-end batched verify; returns (len(public_keys),) bool.
+
+    Batches are padded to the smallest bucket unless an explicit
+    ``batch_size`` is forced.
+    """
+    if batch_size is None:
+        batch_size = bucket_for(len(public_keys))
+    a, r, s_w, h_w, valid = prepare_batch(
+        public_keys, messages, signatures, batch_size
+    )
+    out = _verify_jit(
+        jnp.asarray(a),
+        jnp.asarray(r),
+        jnp.asarray(s_w),
+        jnp.asarray(h_w),
+        jnp.asarray(valid),
+    )
+    return np.asarray(out)[: len(public_keys)]

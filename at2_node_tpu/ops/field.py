@@ -1,0 +1,265 @@
+"""GF(2^255 - 19) arithmetic on TPU, vectorized over signature batches.
+
+This is the foundation of the batched ed25519 verifier — the TPU-native
+replacement for the reference's per-message CPU verification inside its
+broadcast crates (`/root/reference/technical.md:7-12`; drop's
+`crypto::sign` used at `/root/reference/src/lib.rs:5`).
+
+Representation
+--------------
+A field element is 20 limbs of 13 bits each, stored in ``int32`` along the
+trailing axis: ``value = sum(limb[i] * 2**(13*i))``. 13-bit limbs are chosen
+for the TPU's vector unit: the MXU/VPU has no 64-bit multiplier, and with
+13-bit limbs a 20-term schoolbook convolution coefficient is bounded by
+``20 * (2^13-1)^2 < 2^31``, so every intermediate fits in a signed int32
+lane with no overflow. Carries use arithmetic shifts, so transiently
+negative limbs (from subtraction) propagate correctly as borrows.
+
+All functions broadcast over leading batch axes; a field element has shape
+``(..., 20)``. Everything here is pure and `jit`/`vmap`/`shard_map`
+compatible: fixed shapes, `lax.fori_loop` for exponentiation chains, no
+data-dependent control flow (invalid encodings are tracked with masks, never
+branches, so one bad signature cannot poison a batch).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 13
+N_LIMBS = 20
+MASK = (1 << LIMB_BITS) - 1
+P = (1 << 255) - 19
+
+# 2^260 = 2^(13*20) ≡ 2^5 * 19 (mod p): the fold multiplier for limbs >= 20.
+FOLD = 19 << 5
+
+# Bits of p that live in the top limb: 255 = 13*19 + 8.
+TOP_BITS = 255 - LIMB_BITS * (N_LIMBS - 1)  # 8
+TOP_MASK = (1 << TOP_BITS) - 1
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host-side: python int -> limb vector (numpy int32)."""
+    x %= P
+    out = np.zeros(N_LIMBS, dtype=np.int32)
+    for i in range(N_LIMBS):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host-side: limb vector -> python int (not reduced mod p)."""
+    limbs = np.asarray(limbs)
+    return sum(int(limbs[..., i]) << (LIMB_BITS * i) for i in range(N_LIMBS)) % P
+
+
+ZERO = int_to_limbs(0)
+ONE = int_to_limbs(1)
+
+
+def _biased_4p() -> np.ndarray:
+    """4p decomposed with every limb large enough that ``a + C - b`` is
+    limb-wise non-negative for weakly-reduced a, b (the classic SIMD
+    subtraction bias, donna-style): borrow one unit from each limb into the
+    limb below, turning [8116, 8191.., 1023] into [16308, 16382.., 1022]."""
+    c = np.zeros(N_LIMBS, dtype=np.int64)
+    t = 4 * P
+    for i in range(N_LIMBS):
+        c[i] = t & MASK
+        t >>= LIMB_BITS
+    for i in range(1, N_LIMBS):
+        c[i] -= 1
+        c[i - 1] += 1 << LIMB_BITS
+    assert (c >= 1000).all() and sum(int(c[i]) << (LIMB_BITS * i) for i in range(N_LIMBS)) == 4 * P
+    return c.astype(np.int32)
+
+
+_BIAS_4P = _biased_4p()
+
+# Weak-reduction invariant W maintained by every op below:
+#   limbs 0..18 in [0, 2^13 + 64], limb 19 in [0, 2^8 + 64]
+# => values < 2^255 + 2^21, and a 20-term product convolution stays < 2^31.
+
+
+def _reduce_round(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel reduction round: fold bits >= 255 of the top limb by 19
+    (2^255 ≡ 19 mod p), then one whole-vector carry shift. All lanes
+    independent — no sequential limb chain, so XLA compiles this to a
+    handful of fused vector ops."""
+    hi = x[..., N_LIMBS - 1] >> TOP_BITS
+    x = x.at[..., N_LIMBS - 1].set(x[..., N_LIMBS - 1] & TOP_MASK)
+    x = x.at[..., 0].add(hi * 19)
+    c = x >> LIMB_BITS
+    x = x & MASK
+    return x.at[..., 1:].add(c[..., :-1])
+
+
+def weak_reduce(x: jnp.ndarray) -> jnp.ndarray:
+    """Two parallel rounds restore the W invariant for any x with limbs
+    bounded by ~2^27 (post-fold products, sums, biased differences)."""
+    return _reduce_round(_reduce_round(x))
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _reduce_round(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # a - b + 4p: limb-wise non-negative thanks to the biased decomposition,
+    # so the carry rounds never see a long borrow ripple.
+    return _reduce_round(a - b + jnp.asarray(_BIAS_4P))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _reduce_round(jnp.asarray(_BIAS_4P) - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 20x20 limb convolution with parallel carry rounds.
+
+    Bounds: W-invariant inputs give convolution coefficients < 2^31 (int32
+    safe). One parallel carry round caps them below 2^18, the 2^260 ≡ 608
+    fold then stays below 2^27, and two more rounds restore W.
+    """
+    batch_shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    conv = jnp.zeros(batch_shape + (2 * N_LIMBS,), dtype=jnp.int32)
+    for i in range(N_LIMBS):
+        conv = conv.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
+    # one parallel carry over the 39 coefficients (carry-out lands in the
+    # zero-initialized 40th slot; coefficients drop below 2^18)
+    c = conv >> LIMB_BITS
+    conv = (conv & MASK).at[..., 1:].add(c[..., :-1])
+    low = conv[..., :N_LIMBS] + FOLD * conv[..., N_LIMBS:]
+    return weak_reduce(low)
+
+
+def _carry_seq(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Exact sequential carry chain; only used by `canonical` (rare: final
+    comparisons and byte export), where bit-exact normalization matters."""
+    out = [x[..., i] for i in range(n)]
+    for i in range(n - 1):
+        c = out[i] >> LIMB_BITS
+        out[i] = out[i] & MASK
+        out[i + 1] = out[i + 1] + c
+    return jnp.stack(out, axis=-1)
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def _pow2k(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """x^(2^k) via k squarings inside a fori_loop (keeps the graph small)."""
+    if k == 1:
+        return square(x)
+    return jax.lax.fori_loop(0, k, lambda _, v: square(v), x)
+
+
+def _pow_t250(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (x^(2^250 - 1), x^11) — the shared prefix of the standard
+    addition chains for inversion (x^(p-2)) and sqrt (x^(2^252-3))."""
+    z2 = square(x)
+    z9 = mul(x, _pow2k(z2, 2))
+    z11 = mul(z2, z9)
+    z_5_0 = mul(z9, square(z11))  # x^(2^5 - 1)
+    z_10_0 = mul(_pow2k(z_5_0, 5), z_5_0)  # x^(2^10 - 1)
+    z_20_0 = mul(_pow2k(z_10_0, 10), z_10_0)
+    z_40_0 = mul(_pow2k(z_20_0, 20), z_20_0)
+    z_50_0 = mul(_pow2k(z_40_0, 10), z_10_0)
+    z_100_0 = mul(_pow2k(z_50_0, 50), z_50_0)
+    z_200_0 = mul(_pow2k(z_100_0, 100), z_100_0)
+    z_250_0 = mul(_pow2k(z_200_0, 50), z_50_0)
+    return z_250_0, z11
+
+
+def invert(x: jnp.ndarray) -> jnp.ndarray:
+    """x^(p-2) (Fermat). invert(0) == 0."""
+    z_250_0, z11 = _pow_t250(x)
+    return mul(_pow2k(z_250_0, 5), z11)
+
+
+def pow22523(x: jnp.ndarray) -> jnp.ndarray:
+    """x^((p-5)/8) = x^(2^252 - 3); the sqrt-ratio exponent (RFC 8032)."""
+    z_250_0, _ = _pow_t250(x)
+    return mul(_pow2k(z_250_0, 2), x)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to the unique representative in [0, p).
+
+    Exact sequential carries (bit-precise), fold bits >= 255, then two
+    rounds of: add 19, carry, and keep the wrapped value iff it overflowed
+    bit 255 (x >= p  <=>  x + 19 >= 2^255).
+    """
+    x = weak_reduce(x)
+    x = _carry_seq(x, N_LIMBS)
+    hi = x[..., N_LIMBS - 1] >> TOP_BITS
+    x = x.at[..., N_LIMBS - 1].set(x[..., N_LIMBS - 1] & TOP_MASK)
+    x = x.at[..., 0].add(hi * 19)
+    x = _carry_seq(x, N_LIMBS)
+    for _ in range(2):
+        c = x.at[..., 0].add(19)
+        c = _carry_seq(c, N_LIMBS)
+        wrapped = c[..., N_LIMBS - 1] >> TOP_BITS  # 1 iff x >= p
+        c = c.at[..., N_LIMBS - 1].set(c[..., N_LIMBS - 1] & TOP_MASK)
+        x = jnp.where((wrapped > 0)[..., None], c, x)
+    return x
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field equality -> bool of the batch shape."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def bytes_to_limbs(b: jnp.ndarray) -> jnp.ndarray:
+    """(…, 32) uint8 little-endian -> (…, 20) int32 limbs (value < 2^256).
+
+    Bit 255 (the ed25519 sign bit) is NOT masked here; callers split it
+    first when parsing compressed points.
+    """
+    b = b.astype(jnp.int32)
+    limbs = []
+    for j in range(N_LIMBS):
+        bit = LIMB_BITS * j
+        k, r = bit // 8, bit % 8
+        v = b[..., k] >> r
+        if k + 1 < 32:
+            v = v | (b[..., k + 1] << (8 - r))
+        if k + 2 < 32 and r > 3:  # 16-r < 13: a third byte is needed
+            v = v | (b[..., k + 2] << (16 - r))
+        limbs.append(v & MASK)
+    return jnp.stack(limbs, axis=-1)
+
+
+def limbs_to_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """Canonical field element -> (…, 32) uint8 little-endian."""
+    x = canonical(x)
+    out = []
+    for k in range(32):
+        bit = 8 * k
+        j, r = bit // LIMB_BITS, bit % LIMB_BITS
+        v = x[..., j] >> r
+        if j + 1 < N_LIMBS:
+            v = v | (x[..., j + 1] << (LIMB_BITS - r))
+        out.append(v & 0xFF)
+    return jnp.stack(out, axis=-1).astype(jnp.uint8)
+
+
+# -- constants (host-computed python ints, embedded as limb arrays) --
+
+D_INT = (-121665 * pow(121666, P - 2, P)) % P  # Edwards d
+SQRT_M1_INT = pow(2, (P - 1) // 4, P)  # sqrt(-1)
+
+D = int_to_limbs(D_INT)
+D2 = int_to_limbs(2 * D_INT % P)
+SQRT_M1 = int_to_limbs(SQRT_M1_INT)

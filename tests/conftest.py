@@ -1,18 +1,19 @@
 """Test configuration: force JAX onto a virtual 8-device CPU platform.
 
 Multi-chip TPU hardware is not available in CI; shardings are validated on a
-virtual CPU mesh (`--xla_force_host_platform_device_count`), mirroring how
-the driver dry-runs the multi-chip path. Must run before `import jax`.
+virtual CPU mesh, mirroring how the driver dry-runs the multi-chip path.
+
+Note: this environment preloads jax via a .pth hook with JAX_PLATFORMS=axon
+baked in, so env-var edits here are too late — `jax.config.update` is the
+reliable way to retarget the (not-yet-initialized) backend.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

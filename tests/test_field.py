@@ -1,0 +1,124 @@
+"""Differential tests: JAX GF(2^255-19) limb arithmetic vs python ints."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from at2_node_tpu.ops import field as fe
+
+RNG = np.random.default_rng(0xA72)
+
+# Eager per-primitive dispatch is orders of magnitude slower than the jitted
+# graphs the real kernels use; jit everything under test once here.
+f_add = jax.jit(fe.add)
+f_sub = jax.jit(fe.sub)
+f_neg = jax.jit(fe.neg)
+f_mul = jax.jit(fe.mul)
+f_square = jax.jit(fe.square)
+f_invert = jax.jit(fe.invert)
+f_pow22523 = jax.jit(fe.pow22523)
+f_canonical = jax.jit(fe.canonical)
+f_eq = jax.jit(fe.eq)
+f_step = jax.jit(lambda acc, A: f_mul(f_add(acc, A), f_sub(acc, A)))
+f_bytes_to_limbs = jax.jit(fe.bytes_to_limbs)
+f_limbs_to_bytes = jax.jit(fe.limbs_to_bytes)
+
+
+def rand_ints(n, below=fe.P):
+    return [int.from_bytes(RNG.bytes(40), "little") % below for _ in range(n)]
+
+
+def to_batch(ints):
+    return jnp.asarray(np.stack([fe.int_to_limbs(x) for x in ints]))
+
+
+def from_batch(limbs):
+    arr = np.asarray(limbs)
+    return [fe.limbs_to_int(arr[i]) for i in range(arr.shape[0])]
+
+
+N = 64
+
+
+def test_limb_roundtrip():
+    xs = rand_ints(N) + [0, 1, fe.P - 1, 2**255 - 20]
+    assert from_batch(to_batch(xs)) == [x % fe.P for x in xs]
+
+
+def test_add_sub_neg():
+    a, b = rand_ints(N), rand_ints(N)
+    A, B = to_batch(a), to_batch(b)
+    assert from_batch(f_add(A, B)) == [(x + y) % fe.P for x, y in zip(a, b)]
+    assert from_batch(f_sub(A, B)) == [(x - y) % fe.P for x, y in zip(a, b)]
+    assert from_batch(f_neg(A)) == [(-x) % fe.P for x in a]
+
+
+def test_mul_square():
+    a, b = rand_ints(N), rand_ints(N)
+    A, B = to_batch(a), to_batch(b)
+    assert from_batch(f_mul(A, B)) == [(x * y) % fe.P for x, y in zip(a, b)]
+    assert from_batch(f_square(A)) == [(x * x) % fe.P for x in a]
+
+
+def test_mul_worst_case_limbs():
+    # all-ones limbs (max magnitude) exercise the int32 overflow bound
+    worst = (1 << 255) - 1
+    xs = [worst, fe.P - 1, fe.P + 5 - fe.P]  # note: reduced on input
+    A = to_batch(xs)
+    assert from_batch(f_mul(A, A)) == [(x % fe.P) ** 2 % fe.P for x in xs]
+
+
+def test_chained_ops_stay_reduced():
+    # long chains must not overflow int32 lanes
+    a = rand_ints(8)
+    A = to_batch(a)
+    acc, ref = A, list(a)
+    for _ in range(25):
+        acc = f_step(acc, A)
+        ref = [((r + x) * (r - x)) % fe.P for r, x in zip(ref, a)]
+    assert from_batch(acc) == ref
+
+
+def test_invert():
+    a = rand_ints(N)
+    A = to_batch(a)
+    assert from_batch(f_invert(A)) == [pow(x, fe.P - 2, fe.P) for x in a]
+    # invert(0) == 0
+    assert from_batch(f_invert(to_batch([0]))) == [0]
+
+
+def test_pow22523():
+    a = rand_ints(16)
+    A = to_batch(a)
+    assert from_batch(f_pow22523(A)) == [pow(x, (fe.P - 5) // 8, fe.P) for x in a]
+
+
+def test_canonical_and_eq():
+    a = rand_ints(16)
+    A = to_batch(a)
+    assert bool(jnp.all(f_eq(f_add(A, to_batch([0] * 16)), A)))
+    # x + p == x (different representations, same value)
+    shifted = A + jnp.asarray(fe.int_to_limbs(0))  # same limbs
+    assert bool(jnp.all(f_eq(shifted, A)))
+    assert not bool(jnp.any(f_eq(f_add(A, to_batch([1] * 16)), A)))
+    # canonical of p and 2^255-1
+    assert from_batch(f_canonical(to_batch([fe.P - 1]))) == [fe.P - 1]
+
+
+def test_bytes_roundtrip():
+    xs = rand_ints(N)
+    raw = np.stack(
+        [np.frombuffer(x.to_bytes(32, "little"), dtype=np.uint8) for x in xs]
+    )
+    limbs = f_bytes_to_limbs(jnp.asarray(raw))
+    assert from_batch(limbs) == xs
+    back = np.asarray(f_limbs_to_bytes(limbs))
+    assert back.tolist() == raw.tolist()
+
+
+def test_constants():
+    assert fe.limbs_to_int(fe.SQRT_M1) ** 2 % fe.P == fe.P - 1
+    # d = -121665/121666
+    assert (fe.D_INT * 121666 + 121665) % fe.P == 0

@@ -1,0 +1,63 @@
+"""Verifier boundary tests: CPU path, TPU batch path, adaptive flush."""
+
+import asyncio
+
+import pytest
+
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.crypto.verifier import CpuVerifier, TpuBatchVerifier, make_verifier
+
+
+def _signed(n, msg=b"hello"):
+    keys = [SignKeyPair.random() for _ in range(n)]
+    return [(k.public, msg, k.sign(msg)) for k in keys]
+
+
+def test_cpu_verifier():
+    async def run():
+        ver = CpuVerifier()
+        items = _signed(4)
+        assert await ver.verify(*items[0])
+        assert not await ver.verify(items[0][0], b"other", items[0][2])
+        results = await ver.verify_many(items)
+        assert results == [True] * 4
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_batch_verifier_flushes_on_timeout():
+    async def run():
+        ver = TpuBatchVerifier(batch_size=256, max_delay=0.01)
+        items = _signed(3)
+        items.append((items[0][0], b"tampered", items[0][2]))
+        results = await ver.verify_many(items)
+        assert results == [True, True, True, False]
+        assert ver.batches_dispatched == 1  # one padded dispatch, not four
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_batch_verifier_flushes_on_size():
+    async def run():
+        ver = TpuBatchVerifier(batch_size=4, max_delay=10.0)
+        items = _signed(4)
+        results = await ver.verify_many(items)
+        assert results == [True] * 4
+        assert ver.batches_dispatched == 1
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_make_verifier():
+    async def run():
+        assert isinstance(make_verifier("cpu"), CpuVerifier)
+        tpu = make_verifier("tpu", batch_size=64)
+        assert isinstance(tpu, TpuBatchVerifier)
+        await tpu.close()
+        with pytest.raises(ValueError):
+            make_verifier("gpu")
+
+    asyncio.run(run())
