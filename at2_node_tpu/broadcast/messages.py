@@ -1,0 +1,140 @@
+"""Wire messages of the three-phase broadcast (gossip / Echo / Ready).
+
+The reference gets these from its murmur/sieve/contagion crates
+(`/root/reference/technical.md:7-15` [dep-inferred]); here they are
+explicit fixed-size binary records so a frame can carry many of them
+back-to-back and batches parse with zero framing overhead:
+
+* ``Payload`` — the gossiped unit: the client-signed transfer plus the
+  sequence number the broadcast layer binds to it (the reference does the
+  same binding via ``sieve::Payload::new(sender, seq, msg, signature)``,
+  `/root/reference/src/bin/server/rpc.rs:277-282`).
+* ``Attestation`` — an Echo or Ready: a node's signed vote that it saw a
+  specific payload content for a given (sender, sequence) slot. Signing
+  bytes carry a phase-specific domain tag so an Echo can never be replayed
+  as a Ready.
+
+All integers little-endian; keys/signatures raw (types.py's canonical
+layout).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+from ..types import ThinTransaction
+
+GOSSIP = 1
+ECHO = 2
+READY = 3
+
+_PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
+_ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
+
+PAYLOAD_WIRE = 1 + _PAYLOAD.size
+ATTEST_WIRE = 1 + _ATTEST.size
+
+_ECHO_TAG = b"at2-node-tpu/echo/v1"
+_READY_TAG = b"at2-node-tpu/ready/v1"
+
+
+class WireError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Payload:
+    """A transfer in flight: (sender, sequence) slot + signed content."""
+
+    sender: bytes
+    sequence: int
+    transaction: ThinTransaction
+    signature: bytes  # client's ed25519 over transaction.signing_bytes()
+
+    @property
+    def slot(self) -> tuple:
+        return (self.sender, self.sequence)
+
+    def encode(self) -> bytes:
+        return bytes([GOSSIP]) + _PAYLOAD.pack(
+            self.sender,
+            self.sequence,
+            self.transaction.recipient,
+            self.transaction.amount,
+            self.signature,
+        )
+
+    def content_hash(self) -> bytes:
+        """Identifies the payload *content* within its slot — what Echo and
+        Ready votes attest to (sieve's equivocation unit)."""
+        return hashlib.sha256(
+            _PAYLOAD.pack(
+                self.sender,
+                self.sequence,
+                self.transaction.recipient,
+                self.transaction.amount,
+                self.signature,
+            )
+        ).digest()
+
+    @staticmethod
+    def decode_body(body: bytes) -> "Payload":
+        sender, seq, recipient, amount, sig = _PAYLOAD.unpack(body)
+        return Payload(sender, seq, ThinTransaction(recipient, amount), sig)
+
+
+@dataclass(frozen=True)
+class Attestation:
+    """An Echo (phase=ECHO) or Ready (phase=READY) vote."""
+
+    phase: int
+    origin: bytes  # ed25519 sign key of the attesting node
+    sender: bytes
+    sequence: int
+    content_hash: bytes
+    signature: bytes
+
+    @staticmethod
+    def signing_bytes(
+        phase: int, sender: bytes, sequence: int, content_hash: bytes
+    ) -> bytes:
+        tag = _ECHO_TAG if phase == ECHO else _READY_TAG
+        return tag + sender + struct.pack("<I", sequence) + content_hash
+
+    def to_sign(self) -> bytes:
+        return self.signing_bytes(
+            self.phase, self.sender, self.sequence, self.content_hash
+        )
+
+    def encode(self) -> bytes:
+        return bytes([self.phase]) + _ATTEST.pack(
+            self.origin, self.sender, self.sequence, self.content_hash, self.signature
+        )
+
+    @staticmethod
+    def decode_body(phase: int, body: bytes) -> "Attestation":
+        origin, sender, seq, chash, sig = _ATTEST.unpack(body)
+        return Attestation(phase, origin, sender, seq, chash, sig)
+
+
+def parse_frame(frame: bytes) -> list:
+    """Split a frame into messages (frames may coalesce many)."""
+    out = []
+    view = memoryview(frame)
+    while view:
+        kind = view[0]
+        if kind == GOSSIP:
+            if len(view) < PAYLOAD_WIRE:
+                raise WireError("truncated payload")
+            out.append(Payload.decode_body(bytes(view[1:PAYLOAD_WIRE])))
+            view = view[PAYLOAD_WIRE:]
+        elif kind in (ECHO, READY):
+            if len(view) < ATTEST_WIRE:
+                raise WireError("truncated attestation")
+            out.append(Attestation.decode_body(kind, bytes(view[1:ATTEST_WIRE])))
+            view = view[ATTEST_WIRE:]
+        else:
+            raise WireError(f"unknown message kind {kind}")
+    return out

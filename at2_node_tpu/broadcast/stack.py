@@ -1,0 +1,336 @@
+"""Three-phase byzantine broadcast: gossip → Echo (consistency) → Ready
+(totality), with every signature routed through the pluggable Verifier.
+
+Re-implements, as one explicit state machine, what the reference composes
+from its murmur / sieve / contagion crates
+(`/root/reference/technical.md:7-15`, wired at
+`/root/reference/src/bin/server/rpc.rs:108-125`):
+
+* **gossip (murmur)** — a new payload is relayed to every peer
+  (`murmur_gossip_size` = full network, `rpc.rs:115`; AllSampler parity,
+  `rpc.rs:124`) after its *client* signature verifies.
+* **Echo (sieve)** — a node Echoes at most ONE payload content per
+  (sender, sequence) slot — the equivocation filter — and sieve-delivers a
+  content once `echo_threshold` distinct peers echoed that same content
+  (`rpc.rs:113`: threshold = peer count).
+* **Ready (contagion)** — on sieve-delivery a node signs a Ready; a
+  content is delivered to the application once `ready_threshold` distinct
+  peers sent Ready for it (`rpc.rs:120`). A node that collects a full
+  Ready quorum without having sieve-delivered joins the quorum
+  (amplification) so delivery is total across correct nodes.
+
+Thresholds count PEERS (self excluded — the reference's config lists the
+N−1 other nodes, `/root/reference/tests/cli.rs:173-184`, and sets every
+threshold to that count, so an empty peer list degenerates to immediate
+self-delivery, matching the reference's standalone-node test
+`/root/reference/tests/server-config-resolve-addrs`).
+
+Verification is the hot path (BASELINE north star): inbound messages are
+deduplicated BEFORE verification, then fanned out to a pool of worker
+tasks whose concurrent `verifier.verify` calls are what fills the TPU
+batch accumulator (`crypto.verifier.TpuBatchVerifier`). State mutations
+happen synchronously after the verify await on the single event loop — the
+same single-writer argument as the reference's actors (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Optional, Set, Tuple
+
+from ..crypto.keys import SignKeyPair
+from ..crypto.verifier import Verifier
+from ..net.peers import Mesh, Peer
+from .messages import ECHO, READY, Attestation, Payload, WireError, parse_frame
+
+logger = logging.getLogger(__name__)
+
+Slot = Tuple[bytes, int]  # (sender public key, sequence)
+
+# A byzantine sender can gossip many conflicting contents for one slot;
+# only the first few are retained (one is enough for correctness — sieve
+# echoes only the first — the margin just tolerates gossip races).
+MAX_CONTENTS_PER_SLOT = 8
+
+# Memory bounds: dedup sets evict FIFO at these caps, and slot states are
+# garbage-collected (delivered slots after DELIVERED_RETENTION, dead slots
+# after SLOT_MAX_AGE) so unauthenticated spam cannot grow RSS unboundedly.
+DEDUP_CAP = 1 << 20
+DELIVERED_RETENTION = 120.0  # s after delivery before the slot compacts
+SLOT_MAX_AGE = 3600.0  # s an undelivered slot may linger
+GC_INTERVAL = 30.0
+
+
+class _BoundedSet:
+    """Insertion-ordered set with FIFO eviction at a fixed capacity."""
+
+    __slots__ = ("_cap", "_items")
+
+    def __init__(self, cap: int) -> None:
+        self._cap = cap
+        self._items: Dict = {}
+
+    def add(self, key) -> None:
+        if key in self._items:
+            return
+        self._items[key] = None
+        if len(self._items) > self._cap:
+            self._items.pop(next(iter(self._items)))
+
+    def __contains__(self, key) -> bool:
+        return key in self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class _SlotState:
+    __slots__ = (
+        "contents",
+        "echoed_hash",
+        "echoes",
+        "readies",
+        "echo_by_origin",
+        "ready_by_origin",
+        "ready_sent",
+        "sieve_delivered",
+        "delivered",
+        "created",
+    )
+
+    def __init__(self) -> None:
+        self.created = time.monotonic()
+        self.contents: Dict[bytes, Payload] = {}  # content_hash -> payload
+        self.echoed_hash: Optional[bytes] = None  # sieve: first content only
+        self.echoes: Dict[bytes, Set[bytes]] = defaultdict(set)  # hash -> origins
+        self.readies: Dict[bytes, Set[bytes]] = defaultdict(set)
+        # first VERIFIED vote per origin per phase wins — a byzantine origin
+        # cannot land in two contents' quorums (echo equivocation guard)
+        self.echo_by_origin: Dict[bytes, bytes] = {}
+        self.ready_by_origin: Dict[bytes, bytes] = {}
+        self.ready_sent = False
+        self.sieve_delivered = False
+        self.delivered = False
+
+
+class Broadcast:
+    """The node's broadcast endpoint: submit via :meth:`broadcast`, consume
+    committed payloads from :attr:`delivered` (an asyncio.Queue of
+    :class:`Payload`, drained in batches by the service's delivery loop)."""
+
+    def __init__(
+        self,
+        keypair: SignKeyPair,
+        mesh: Mesh,
+        verifier: Verifier,
+        echo_threshold: Optional[int] = None,
+        ready_threshold: Optional[int] = None,
+        workers: int = 64,
+    ) -> None:
+        self.keypair = keypair
+        self.mesh = mesh
+        self.verifier = verifier
+        n_peers = len(mesh.peers)
+        # Reference parity: every threshold defaults to the peer count
+        # (rpc.rs:112-120); configurable so f>0 setups are testable
+        # (SURVEY.md §5 failure-detection note).
+        self.echo_threshold = n_peers if echo_threshold is None else echo_threshold
+        self.ready_threshold = n_peers if ready_threshold is None else ready_threshold
+        self.workers = workers
+        self.delivered: asyncio.Queue = asyncio.Queue()
+        self._slots: Dict[Slot, _SlotState] = {}
+        self._inbox: asyncio.Queue = asyncio.Queue(maxsize=65536)
+        self._tasks: list = []
+        # inflight verification dedup: messages identical to one already
+        # being verified are coalesced instead of re-verified
+        self._gossip_seen = _BoundedSet(DEDUP_CAP)
+        self._attest_seen = _BoundedSet(DEDUP_CAP)
+        # slots compacted away after delivery; membership blocks re-delivery
+        self._delivered_slots = _BoundedSet(DEDUP_CAP)
+        # observability counters (SURVEY.md §5: per-stage counters)
+        self.stats = {
+            "gossip_rx": 0,
+            "echo_rx": 0,
+            "ready_rx": 0,
+            "invalid_sig": 0,
+            "delivered": 0,
+        }
+
+    async def start(self) -> None:
+        for _ in range(self.workers):
+            self._tasks.append(asyncio.create_task(self._worker()))
+        self._tasks.append(asyncio.create_task(self._gc_loop()))
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+    # -- inbound ----------------------------------------------------------
+
+    async def on_frame(self, peer: Peer, frame: bytes) -> None:
+        """Mesh callback: parse and enqueue; drops (best-effort plane) when
+        the inbox is saturated rather than back-pressuring the socket."""
+        try:
+            msgs = parse_frame(frame)
+        except WireError as exc:
+            logger.warning("bad frame from %s: %s", peer.address, exc)
+            return
+        for msg in msgs:
+            try:
+                self._inbox.put_nowait(msg)
+            except asyncio.QueueFull:
+                logger.warning("inbox overflow; dropping message")
+
+    async def broadcast(self, payload: Payload) -> None:
+        """Local submission (the gRPC SendAsset handler calls this —
+        reference: `handle.broadcast`, rpc.rs:275-284)."""
+        await self._inbox.put(payload)
+
+    # -- workers ----------------------------------------------------------
+
+    async def _gc_loop(self) -> None:
+        """Compact delivered slots and expire dead ones (memory bound)."""
+        while True:
+            await asyncio.sleep(GC_INTERVAL)
+            now = time.monotonic()
+            for slot in list(self._slots):
+                state = self._slots[slot]
+                age = now - state.created
+                if state.delivered and age > DELIVERED_RETENTION:
+                    self._delivered_slots.add(slot)
+                    del self._slots[slot]
+                elif age > SLOT_MAX_AGE:
+                    del self._slots[slot]
+
+    async def _worker(self) -> None:
+        while True:
+            msg = await self._inbox.get()
+            try:
+                if isinstance(msg, Payload):
+                    await self._on_gossip(msg)
+                else:
+                    await self._on_attestation(msg)
+            except Exception:
+                logger.exception("broadcast worker error")
+
+    async def _on_gossip(self, payload: Payload) -> None:
+        self.stats["gossip_rx"] += 1
+        slot = payload.slot
+        if slot in self._delivered_slots:
+            return  # already committed and compacted
+        chash = payload.content_hash()
+        key = (slot, chash)
+        if key in self._gossip_seen:
+            return
+        self._gossip_seen.add(key)
+        state = self._slots.get(slot)
+        if state is not None and (
+            len(state.contents) >= MAX_CONTENTS_PER_SLOT or chash in state.contents
+        ):
+            return
+        ok = await self.verifier.verify(
+            payload.sender, payload.transaction.signing_bytes(), payload.signature
+        )
+        if not ok:
+            self.stats["invalid_sig"] += 1
+            logger.warning(
+                "invalid payload signature for slot (%s, %d)",
+                payload.sender.hex()[:16],
+                payload.sequence,
+            )
+            return
+        state = self._slots.setdefault(slot, _SlotState())
+        if chash in state.contents or len(state.contents) >= MAX_CONTENTS_PER_SLOT:
+            return
+        state.contents[chash] = payload
+        # murmur: relay to everyone (gossip_size = full network)
+        self.mesh.broadcast(payload.encode())
+        # sieve: echo only the FIRST content seen for this slot
+        if state.echoed_hash is None:
+            state.echoed_hash = chash
+            self._send_attestation(ECHO, payload.sender, payload.sequence, chash)
+        self._advance(slot, state, chash)
+
+    async def _on_attestation(self, att: Attestation) -> None:
+        phase_key = "echo_rx" if att.phase == ECHO else "ready_rx"
+        self.stats[phase_key] += 1
+        if att.origin not in self.mesh.by_sign:
+            logger.warning("attestation from unknown origin %s", att.origin.hex()[:16])
+            return
+        slot = (att.sender, att.sequence)
+        if slot in self._delivered_slots:
+            return  # already committed and compacted
+        # Exact-duplicate suppression keyed INCLUDING the signature, so a
+        # forged message can never shadow the origin's real (differently
+        # signed) vote; per-origin single-vote enforcement happens after
+        # verification via *_by_origin below.
+        seen_key = (att.phase, att.origin, slot, att.content_hash, att.signature)
+        if seen_key in self._attest_seen:
+            return
+        self._attest_seen.add(seen_key)
+        state = self._slots.get(slot)
+        by_origin = None
+        if state is not None:
+            by_origin = state.echo_by_origin if att.phase == ECHO else state.ready_by_origin
+            if att.origin in by_origin:
+                return  # this origin already cast a verified vote here
+        ok = await self.verifier.verify(att.origin, att.to_sign(), att.signature)
+        if not ok:
+            self.stats["invalid_sig"] += 1
+            logger.warning("invalid %s signature from %s",
+                           "echo" if att.phase == ECHO else "ready",
+                           att.origin.hex()[:16])
+            return
+        state = self._slots.setdefault(slot, _SlotState())
+        by_origin = state.echo_by_origin if att.phase == ECHO else state.ready_by_origin
+        if att.origin in by_origin:
+            return
+        by_origin[att.origin] = att.content_hash
+        votes = state.echoes if att.phase == ECHO else state.readies
+        votes[att.content_hash].add(att.origin)
+        self._advance(slot, state, att.content_hash)
+
+    # -- state transitions (synchronous; no awaits) -----------------------
+
+    def _send_attestation(
+        self, phase: int, sender: bytes, sequence: int, chash: bytes
+    ) -> None:
+        sig = self.keypair.sign(Attestation.signing_bytes(phase, sender, sequence, chash))
+        att = Attestation(phase, self.keypair.public, sender, sequence, chash, sig)
+        self.mesh.broadcast(att.encode())
+
+    def _advance(self, slot: Slot, state: _SlotState, chash: bytes) -> None:
+        """Drive the slot's phase transitions for one content hash."""
+        if state.delivered:
+            return
+        # sieve-deliver: enough echoes for this content (quorum-driven; the
+        # per-origin single-vote rule above makes two quorums impossible
+        # whenever echo_threshold > n_peers/2)
+        if (
+            not state.sieve_delivered
+            and len(state.echoes[chash]) >= self.echo_threshold
+        ):
+            state.sieve_delivered = True
+            if not state.ready_sent:
+                state.ready_sent = True
+                self._send_attestation(READY, slot[0], slot[1], chash)
+        # contagion amplification: a full Ready quorum convinces a node
+        # that missed the Echo phase to join (keeps delivery total)
+        if (
+            not state.ready_sent
+            and len(state.readies[chash]) >= max(self.ready_threshold, 1)
+        ):
+            state.ready_sent = True
+            self._send_attestation(READY, slot[0], slot[1], chash)
+        # deliver: enough readies AND the payload content is known
+        if (
+            len(state.readies[chash]) >= self.ready_threshold
+            and state.ready_sent
+            and chash in state.contents
+        ):
+            state.delivered = True
+            self.stats["delivered"] += 1
+            self.delivered.put_nowait(state.contents[chash])

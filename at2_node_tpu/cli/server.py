@@ -1,0 +1,102 @@
+"""`server` CLI: `config {new,get-node}` and `run`.
+
+Same subcommand surface and stdin/stdout TOML piping as the reference
+server binary (`/root/reference/src/bin/server/main.rs:17-140`):
+
+    server config new <node_address> <rpc_address>   > node.toml
+    server config get-node < node.toml               # shareable fragment
+    server run < node.toml                           # serve forever
+
+Peers are added by appending other nodes' `get-node` fragments to the
+config, exactly the reference operator workflow
+(`/root/reference/README.md:26-27`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from ..crypto.keys import ExchangeKeyPair, SignKeyPair
+from ..node.config import Config
+
+
+def cmd_config_new(args: argparse.Namespace) -> int:
+    config = Config(
+        node_address=args.node_address,
+        rpc_address=args.rpc_address,
+        sign_key=SignKeyPair.random(),
+        network_key=ExchangeKeyPair.random(),
+    )
+    sys.stdout.write(config.dumps())
+    return 0
+
+
+def cmd_config_get_node(args: argparse.Namespace) -> int:
+    config = Config.load(sys.stdin)
+    sys.stdout.write(config.node_fragment())
+    return 0
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    # WARN-level logging by default, like the reference's tracing setup
+    # (`server/main.rs:94-99`); AT2_LOG overrides for debugging.
+    import os
+
+    logging.basicConfig(
+        level=os.environ.get("AT2_LOG", "WARNING").upper(),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    config = Config.load(sys.stdin)
+
+    async def main() -> None:
+        from ..node.service import Service
+
+        service = await Service.start(config)
+        try:
+            await service.serve_forever()
+        finally:
+            await service.close()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    except OSError as exc:
+        print(f"server: {exc}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="server", description="AT2 node")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    config = sub.add_parser("config", help="manage node configuration")
+    config_sub = config.add_subparsers(dest="config_command", required=True)
+
+    new = config_sub.add_parser("new", help="generate a fresh node config")
+    new.add_argument("node_address", help="host:port of the node-to-node plane")
+    new.add_argument("rpc_address", help="host:port of the client gRPC plane")
+    new.set_defaults(func=cmd_config_new)
+
+    get_node = config_sub.add_parser(
+        "get-node", help="print this node's shareable [[nodes]] fragment"
+    )
+    get_node.set_defaults(func=cmd_config_get_node)
+
+    run = sub.add_parser("run", help="run the node (config on stdin)")
+    run.set_defaults(func=cmd_run)
+
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Typed AT2 client library over the gRPC surface.
+
+Equivalent of `at2_node::client::Client`
+(`/root/reference/src/client.rs:44-144`): a thin wrapper around the
+`at2.AT2` stub that signs transfers client-side
+(`client.rs:77-78`) and decodes replies into the shared types. Used by
+the client CLI and the benchmark load generators.
+
+Like the reference, the channel is lazy: nothing connects until the first
+RPC (`client.rs:61`, tonic `connect_lazy`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import grpc
+
+from .crypto.keys import SignKeyPair
+from .proto import at2_pb2 as pb
+from .proto.rpc import At2Stub
+from .types import (
+    FullTransaction,
+    ThinTransaction,
+    TransactionState,
+    parse_rfc3339,
+)
+
+
+def _target(uri: str) -> str:
+    """grpc.aio targets are host:port; accept http:// URLs for parity with
+    the reference's Uri-based config (`client.rs:51-64`)."""
+    for prefix in ("http://", "https://"):
+        if uri.startswith(prefix):
+            uri = uri[len(prefix):]
+    return uri.rstrip("/")
+
+
+class Client:
+    def __init__(self, uri: str) -> None:
+        self._channel = grpc.aio.insecure_channel(_target(uri))
+        self._stub = At2Stub(self._channel)
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def __aenter__(self) -> "Client":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def send_asset(
+        self,
+        keypair: SignKeyPair,
+        sequence: int,
+        recipient: bytes,
+        amount: int,
+    ) -> None:
+        """Sign and submit a transfer (`client.rs:70-91`). The signature
+        covers the canonical ThinTransaction bytes; the sequence rides
+        outside the signed struct, bound in by the broadcast layer
+        (reference parity, `client.rs:77-78`, SURVEY.md C13)."""
+        thin = ThinTransaction(recipient, amount)
+        signature = keypair.sign(thin.signing_bytes())
+        await self._stub.SendAsset(
+            pb.SendAssetRequest(
+                sender=keypair.public,
+                sequence=sequence,
+                recipient=recipient,
+                amount=amount,
+                signature=signature,
+            )
+        )
+
+    async def get_balance(self, user: bytes) -> int:
+        reply = await self._stub.GetBalance(pb.GetBalanceRequest(sender=user))
+        return reply.amount
+
+    async def get_last_sequence(self, user: bytes) -> int:
+        reply = await self._stub.GetLastSequence(
+            pb.GetLastSequenceRequest(sender=user)
+        )
+        return reply.sequence
+
+    async def get_latest_transactions(self) -> List[FullTransaction]:
+        reply = await self._stub.GetLatestTransactions(
+            pb.GetLatestTransactionsRequest()
+        )
+        return [
+            FullTransaction(
+                timestamp=parse_rfc3339(tx.timestamp),
+                sender=tx.sender,
+                sender_sequence=tx.sender_sequence,
+                recipient=tx.recipient,
+                amount=tx.amount,
+                state=TransactionState(tx.state),
+            )
+            for tx in reply.transactions
+        ]

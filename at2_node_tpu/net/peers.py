@@ -1,0 +1,187 @@
+"""Full-mesh peer connection manager.
+
+Equivalent of drop's `System` / `SystemManager` / `NetworkSender`
+(`/root/reference/src/bin/server/rpc.rs:19,88-125`): bring up an encrypted
+listener, dial every configured peer, and expose send/broadcast keyed by
+peer identity. Improvements over the reference consciously taken:
+
+* dropped connections ARE re-dialed with exponential backoff — the
+  reference leaves this as "TODO readd connections if dropped"
+  (`rpc.rs:87`);
+* inbound connections from unknown exchange keys are rejected at the
+  handshake boundary (the reference relies on drop's Exchanger for the
+  same property [dep-inferred]).
+
+Each ordered pair of nodes uses one TCP connection: the initiator writes,
+the responder reads. A full mesh of N nodes therefore carries N·(N−1)
+connections, each authenticated by the X25519 handshake
+(`at2_node_tpu.net.transport`).
+
+Delivery is best-effort (murmur semantics, `/root/reference/technical.md:9-10`):
+sends while a peer is down are buffered in a bounded queue and dropped
+oldest-first on overflow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Dict, Iterable, Optional
+
+from ..crypto.keys import ExchangeKeyPair
+from . import transport
+
+logger = logging.getLogger(__name__)
+
+SEND_QUEUE_CAP = 4096
+
+
+@dataclass(frozen=True)
+class Peer:
+    """One row of the config's `[[nodes]]` table
+    (`/root/reference/src/bin/server/config.rs:29-38` + this build's
+    added `sign_public_key`)."""
+
+    address: str  # "host:port" of the peer's node plane
+    exchange_public: bytes  # 32-byte X25519 key (channel identity)
+    sign_public: bytes  # 32-byte ed25519 key (Echo/Ready signing identity)
+
+    def host_port(self) -> tuple:
+        host, _, port = self.address.rpartition(":")
+        return host, int(port)
+
+
+class Mesh:
+    """Maintains channels to all peers; calls back on every inbound frame."""
+
+    def __init__(
+        self,
+        listen_addr: str,
+        keypair: ExchangeKeyPair,
+        peers: Iterable[Peer],
+        on_frame: Callable[[Peer, bytes], Awaitable[None]],
+    ) -> None:
+        self.listen_addr = listen_addr
+        self.keypair = keypair
+        self.peers = [p for p in peers if p.exchange_public != keypair.public]
+        self.by_exchange: Dict[bytes, Peer] = {
+            p.exchange_public: p for p in self.peers
+        }
+        self.by_sign: Dict[bytes, Peer] = {p.sign_public: p for p in self.peers}
+        self.on_frame = on_frame
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._send_queues: Dict[bytes, asyncio.Queue] = {}
+        self._tasks: list = []
+        self._channels: set = set()  # live channels, closed on shutdown
+        self._closed = False
+
+    async def start(self) -> None:
+        host, _, port = self.listen_addr.rpartition(":")
+        self._server = await asyncio.start_server(
+            self._handle_inbound, host or "0.0.0.0", int(port)
+        )
+        for peer in self.peers:
+            q: asyncio.Queue = asyncio.Queue(maxsize=SEND_QUEUE_CAP)
+            self._send_queues[peer.exchange_public] = q
+            self._tasks.append(asyncio.create_task(self._outbound_loop(peer, q)))
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        for channel in list(self._channels):
+            channel.close()
+        self._channels.clear()
+        if self._server is not None:
+            self._server.close()
+
+    # -- sending ----------------------------------------------------------
+
+    def send(self, peer: Peer, frame: bytes) -> None:
+        """Queue a frame for one peer; never blocks (best-effort plane)."""
+        q = self._send_queues.get(peer.exchange_public)
+        if q is None:
+            return
+        while True:
+            try:
+                q.put_nowait(frame)
+                return
+            except asyncio.QueueFull:
+                try:  # drop the oldest queued frame and retry
+                    q.get_nowait()
+                    logger.warning("send queue overflow to %s", peer.address)
+                except asyncio.QueueEmpty:
+                    pass
+
+    def broadcast(self, frame: bytes, exclude: Iterable[bytes] = ()) -> None:
+        skip = set(exclude)
+        for peer in self.peers:
+            if peer.exchange_public not in skip:
+                self.send(peer, frame)
+
+    # -- connection maintenance -------------------------------------------
+
+    async def _outbound_loop(self, peer: Peer, q: asyncio.Queue) -> None:
+        backoff = 0.1
+        host, port = peer.host_port()
+        pending: Optional[bytes] = None
+        while not self._closed:
+            try:
+                channel = await transport.connect(host, port, self.keypair)
+            except (OSError, transport.HandshakeError, asyncio.TimeoutError):
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                continue
+            if channel.peer_public != peer.exchange_public:
+                logger.warning(
+                    "peer %s presented unexpected key %s",
+                    peer.address,
+                    channel.peer_public.hex(),
+                )
+                channel.close()
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                continue
+            backoff = 0.1
+            self._channels.add(channel)
+            try:
+                while True:
+                    frame = pending if pending is not None else await q.get()
+                    pending = frame
+                    await channel.send(frame)
+                    pending = None
+            except (transport.ChannelClosed, ConnectionError):
+                logger.warning("connection to %s dropped; redialing", peer.address)
+            finally:
+                channel.close()
+                self._channels.discard(channel)
+
+    async def _handle_inbound(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            channel = await transport.accept(reader, writer, self.keypair)
+        except (transport.HandshakeError, asyncio.TimeoutError, OSError):
+            writer.close()
+            return
+        peer = self.by_exchange.get(channel.peer_public)
+        if peer is None:
+            logger.warning(
+                "rejecting connection from unknown key %s",
+                channel.peer_public.hex(),
+            )
+            channel.close()
+            return
+        self._channels.add(channel)
+        try:
+            while True:
+                frame = await channel.recv()
+                await self.on_frame(peer, frame)
+        except (transport.ChannelClosed, ConnectionError):
+            pass
+        except Exception:
+            logger.exception("inbound handler error from %s", peer.address)
+        finally:
+            channel.close()
+            self._channels.discard(channel)

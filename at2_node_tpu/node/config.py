@@ -1,0 +1,128 @@
+"""Server TOML configuration.
+
+Same schema and operator workflow as the reference
+(`/root/reference/src/bin/server/config.rs:6-38`): a `Config{addresses
+{node, rpc}, keys{sign, network}, nodes = [{address, public_key}]}` TOML
+document piped via stdin/stdout, peers appended by textually concatenating
+`config get-node` fragments (`/root/reference/README.md:26-27`,
+`/root/reference/tests/cli.rs:172-184`).
+
+Two conscious additions over the reference schema:
+
+* each `[[nodes]]` row also carries `sign_public_key` — this build's nodes
+  sign their own Echo/Ready attestations (the work the TPU verifier
+  batches), so peers must know each other's ed25519 keys, not only the
+  channel (X25519) keys;
+* an optional `[verifier]` table — `kind = "cpu" | "tpu"`, `batch_size`,
+  `max_delay` — the plugin selection the BASELINE north star requires
+  (SURVEY.md §5 "config/flag system").
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import List, Optional, TextIO
+
+from ..crypto.keys import ExchangeKeyPair, SignKeyPair
+from ..net.peers import Peer
+
+
+@dataclass
+class VerifierConfig:
+    kind: str = "cpu"
+    batch_size: int = 256
+    max_delay: float = 0.002
+
+    def make(self):
+        from ..crypto.verifier import make_verifier
+
+        if self.kind == "tpu":
+            return make_verifier(
+                "tpu", batch_size=self.batch_size, max_delay=self.max_delay
+            )
+        return make_verifier("cpu")
+
+
+@dataclass
+class Config:
+    node_address: str
+    rpc_address: str
+    sign_key: SignKeyPair
+    network_key: ExchangeKeyPair
+    nodes: List[Peer] = field(default_factory=list)
+    verifier: VerifierConfig = field(default_factory=VerifierConfig)
+    echo_threshold: Optional[int] = None
+    ready_threshold: Optional[int] = None
+
+    # -- TOML -------------------------------------------------------------
+
+    def dumps(self) -> str:
+        lines = []
+        # top-level keys must precede any table header in TOML
+        if self.echo_threshold is not None:
+            lines.append(f"echo_threshold = {self.echo_threshold}")
+        if self.ready_threshold is not None:
+            lines.append(f"ready_threshold = {self.ready_threshold}")
+        lines += [
+            "[addresses]",
+            f'node = "{self.node_address}"',
+            f'rpc = "{self.rpc_address}"',
+            "",
+            "[keys]",
+            f'sign = "{self.sign_key.to_hex()}"',
+            f'network = "{self.network_key.to_hex()}"',
+            "",
+            "[verifier]",
+            f'kind = "{self.verifier.kind}"',
+            f"batch_size = {self.verifier.batch_size}",
+            f"max_delay = {self.verifier.max_delay}",
+        ]
+        for peer in self.nodes:
+            lines += [
+                "",
+                "[[nodes]]",
+                f'address = "{peer.address}"',
+                f'public_key = "{peer.exchange_public.hex()}"',
+                f'sign_public_key = "{peer.sign_public.hex()}"',
+            ]
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def loads(text: str) -> "Config":
+        doc = tomllib.loads(text)
+        verifier = VerifierConfig(**doc.get("verifier", {}))
+        return Config(
+            node_address=doc["addresses"]["node"],
+            rpc_address=doc["addresses"]["rpc"],
+            sign_key=SignKeyPair.from_hex(doc["keys"]["sign"]),
+            network_key=ExchangeKeyPair.from_hex(doc["keys"]["network"]),
+            nodes=[
+                Peer(
+                    address=n["address"],
+                    exchange_public=bytes.fromhex(n["public_key"]),
+                    sign_public=bytes.fromhex(n["sign_public_key"]),
+                )
+                for n in doc.get("nodes", [])
+            ],
+            verifier=verifier,
+            echo_threshold=doc.get("echo_threshold"),
+            ready_threshold=doc.get("ready_threshold"),
+        )
+
+    @staticmethod
+    def load(fp: TextIO) -> "Config":
+        return Config.loads(fp.read())
+
+    def node_fragment(self) -> str:
+        """The shareable `config get-node` output: this node's address and
+        public identities, as a `[[nodes]]` TOML fragment
+        (`/root/reference/src/bin/server/main.rs:74-88`)."""
+        return "\n".join(
+            [
+                "[[nodes]]",
+                f'address = "{self.node_address}"',
+                f'public_key = "{self.network_key.public.hex()}"',
+                f'sign_public_key = "{self.sign_key.public.hex()}"',
+            ]
+        ) + "\n"

@@ -1,0 +1,239 @@
+"""The AT2 node: broadcast wiring + delivery→commit loop + gRPC surface.
+
+Equivalent of the reference's `rpc::Service`
+(`/root/reference/src/bin/server/rpc.rs:61-344`): bring up the encrypted
+node mesh, run the three-phase broadcast with the configured Verifier,
+drain deliveries into the ledger with the reference's exact ordering /
+retry / TTL semantics, and serve the four `at2.AT2` RPCs to clients.
+
+Delivery→commit loop parity (`rpc.rs:149-211`):
+
+* delivered payloads enter a min-heap ordered by (sequence, sender,
+  content) with their arrival time (`rpc.rs:163-173`);
+* the heap is drained to a fixpoint — a pass that commits anything
+  re-sorts and retries, so out-of-order sequences gap-fill
+  (`rpc.rs:176-208`);
+* only sequence/balance failures (`AccountModificationError`) are retried;
+  anything else is logged and dropped (`rpc.rs:195-205`);
+* a payload older than ``TRANSACTION_TTL`` (60 s) is marked Failure —
+  and then still falls through to processing, so it can later flip to
+  Success: the reference has no `continue` after its TTL branch
+  (`rpc.rs:183-193`), and that observable quirk is kept deliberately;
+* leftovers carry into the next delivery batch (`rpc.rs:207`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import logging
+import time
+from typing import List, Optional, Tuple
+
+import grpc
+
+from ..broadcast.messages import Payload
+from ..broadcast.stack import Broadcast
+from ..crypto.verifier import Verifier
+from ..ledger.accounts import AccountModificationError, Accounts
+from ..ledger.recent import RecentTransactions
+from ..net.peers import Mesh
+from ..proto import at2_pb2 as pb
+from ..proto.rpc import At2Servicer, add_to_server
+from ..types import ThinTransaction, TransactionState, rfc3339
+from .config import Config
+
+logger = logging.getLogger(__name__)
+
+TRANSACTION_TTL = 60.0  # seconds, rpc.rs:35
+
+
+class Service(At2Servicer):
+    """One AT2 node. `await Service.start(config)`, then `serve_forever`."""
+
+    def __init__(self, config: Config) -> None:
+        self.config = config
+        self.accounts = Accounts()
+        self.recent = RecentTransactions()
+        self.verifier: Optional[Verifier] = None
+        self.mesh: Optional[Mesh] = None
+        self.broadcast: Optional[Broadcast] = None
+        self._grpc_server: Optional[grpc.aio.Server] = None
+        self._delivery_task: Optional[asyncio.Task] = None
+        # leftovers: (key, arrival, tiebreak, payload) carried across batches
+        self._heap: List[tuple] = []
+        self._push_count = 0  # monotonic heap tiebreaker
+
+    # -- lifecycle --------------------------------------------------------
+
+    @staticmethod
+    async def start(config: Config) -> "Service":
+        service = Service(config)
+        service.verifier = config.verifier.make()
+        service.mesh = Mesh(
+            config.node_address,
+            config.network_key,
+            config.nodes,
+            on_frame=lambda peer, frame: service.broadcast.on_frame(peer, frame),
+        )
+        service.broadcast = Broadcast(
+            config.sign_key,
+            service.mesh,
+            service.verifier,
+            echo_threshold=config.echo_threshold,
+            ready_threshold=config.ready_threshold,
+        )
+        await service.mesh.start()
+        await service.broadcast.start()
+        service._delivery_task = asyncio.create_task(service._delivery_loop())
+
+        server = grpc.aio.server()
+        add_to_server(service, server)
+        bound = server.add_insecure_port(config.rpc_address)
+        if bound == 0:
+            await service.close()
+            raise OSError(f"cannot bind rpc address {config.rpc_address}")
+        await server.start()
+        service._grpc_server = server
+        logger.info(
+            "node up: mesh on %s, rpc on %s, %d peers, verifier=%s",
+            config.node_address,
+            config.rpc_address,
+            len(service.mesh.peers),
+            config.verifier.kind,
+        )
+        return service
+
+    async def serve_forever(self) -> None:
+        await self._grpc_server.wait_for_termination()
+
+    async def close(self) -> None:
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.5)
+        if self._delivery_task is not None:
+            self._delivery_task.cancel()
+        if self.broadcast is not None:
+            await self.broadcast.close()
+        if self.mesh is not None:
+            await self.mesh.close()
+        if self.verifier is not None:
+            await self.verifier.close()
+
+    # -- delivery → commit loop ------------------------------------------
+
+    async def _delivery_loop(self) -> None:
+        queue = self.broadcast.delivered
+        while True:
+            payload = await queue.get()
+            batch = [payload]
+            while True:  # greedy drain: one pass per delivered batch
+                try:
+                    batch.append(queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            now = time.monotonic()
+            for p in batch:
+                key = (p.sequence, p.sender, p.transaction.recipient, p.transaction.amount)
+                self._push_count += 1
+                heapq.heappush(self._heap, (key, now, self._push_count, p))
+            await self._drain_to_fixpoint()
+
+    async def _drain_to_fixpoint(self) -> None:
+        # Mirrors rpc.rs:176-208: keep passing over the (sorted) pending
+        # set while progress is made; retry only AccountModification
+        # errors so a sequence gap fills once its predecessor lands.
+        pending = self._heap
+        while True:
+            before = len(pending)
+            retry: List[tuple] = []
+            pending.sort()
+            for key, added, tiebreak, payload in pending:
+                if time.monotonic() - added > TRANSACTION_TTL:
+                    logger.warning(
+                        "transaction timed out: (%s, %d)",
+                        payload.sender.hex()[:16],
+                        payload.sequence,
+                    )
+                    await self.recent.update(
+                        payload.sender, payload.sequence, TransactionState.FAILURE
+                    )
+                    # NO continue — TTL-expired payloads still process and
+                    # may flip to Success (reference quirk, rpc.rs:183-205)
+                try:
+                    await self._process_payload(payload)
+                except AccountModificationError as exc:
+                    logger.debug(
+                        "retrying payload (%s, %d): %s",
+                        payload.sender.hex()[:16],
+                        payload.sequence,
+                        exc,
+                    )
+                    retry.append((key, added, tiebreak, payload))
+                except Exception as exc:
+                    logger.warning("dropping bad payload: %s", exc)
+            pending[:] = retry
+            heapq.heapify(pending)
+            if not pending or len(pending) >= before:
+                return
+
+    async def _process_payload(self, payload: Payload) -> None:
+        # rpc.rs:213-237: commit to the ledger, then flip the ring entry.
+        logger.info(
+            "new payload: seq=%d sender=%s",
+            payload.sequence,
+            payload.sender.hex()[:16],
+        )
+        await self.accounts.transfer(
+            payload.sender,
+            payload.sequence,
+            payload.transaction.recipient,
+            payload.transaction.amount,
+        )
+        await self.recent.update(
+            payload.sender, payload.sequence, TransactionState.SUCCESS
+        )
+
+    # -- gRPC handlers (rpc.rs:256-344) ----------------------------------
+
+    async def SendAsset(self, request, context):
+        if len(request.sender) != 32 or len(request.recipient) != 32:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "keys must be 32 bytes"
+            )
+        if len(request.signature) != 64:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "signature must be 64 bytes"
+            )
+        try:
+            thin = ThinTransaction(request.recipient, request.amount)
+        except ValueError as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        await self.recent.put(request.sender, request.sequence, thin)
+        payload = Payload(request.sender, request.sequence, thin, request.signature)
+        # fire-and-forget: the ACK is not a commit receipt (rpc.rs:286)
+        await self.broadcast.broadcast(payload)
+        return pb.SendAssetReply()
+
+    async def GetBalance(self, request, context):
+        amount = await self.accounts.get_balance(request.sender)
+        return pb.GetBalanceReply(amount=amount)
+
+    async def GetLastSequence(self, request, context):
+        sequence = await self.accounts.get_last_sequence(request.sender)
+        return pb.GetLastSequenceReply(sequence=sequence)
+
+    async def GetLatestTransactions(self, request, context):
+        txs = await self.recent.get_all()
+        return pb.GetLatestTransactionsReply(
+            transactions=[
+                pb.FullTransaction(
+                    timestamp=rfc3339(tx.timestamp),
+                    sender=tx.sender,
+                    recipient=tx.recipient,
+                    amount=tx.amount,
+                    state=tx.state.value,
+                    sender_sequence=tx.sender_sequence,
+                )
+                for tx in txs
+            ]
+        )

@@ -8,6 +8,8 @@ baked in, so env-var edits here are too late — `jax.config.update` is the
 reliable way to retarget the (not-yet-initialized) backend.
 """
 
+import asyncio
+import inspect
 import os
 import sys
 
@@ -17,3 +19,21 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run the coroutine test on a fresh event loop")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async-test support (pytest-asyncio is not installed): any
+    coroutine test function runs on a fresh event loop."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,210 @@
+"""CLI end-to-end tests: real `server` / `client` processes, TOML over
+stdin, output over stdout — the reference's integration tier
+(`/root/reference/tests/cli.rs`) and shell tier
+(`/root/reference/tests/lib.sh`) translated to this build's binaries.
+
+Network bootstrap follows the reference operator workflow exactly
+(`cli.rs:162-208`): generate one config per node, append every OTHER
+node's `config get-node` fragment, spawn `server run` with the config on
+stdin, wait for the ports to accept connections, then drive everything
+through the `client` CLI.
+"""
+
+import itertools
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVER = [sys.executable, "-m", "at2_node_tpu.cli.server"]
+CLIENT = [sys.executable, "-m", "at2_node_tpu.cli.client"]
+
+# reference's polling budget: cli.rs:24-25
+TICK = 0.1
+TIMEOUT = 30.0  # interpreter startup is slower than a Rust binary
+
+_ports = itertools.count(44000)
+
+
+def run_cli(argv, stdin=None, check=True):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        argv, input=stdin, capture_output=True, text=True, env=env, timeout=60
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(f"{argv} failed: {proc.stderr}")
+    return proc
+
+
+def wait_for_port(port, timeout=TIMEOUT):
+    # cli.rs:119-131 wait_until_connect
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(TICK)
+    raise TimeoutError(f"port {port} never came up")
+
+
+class ServerProcess:
+    def __init__(self, config, node_port, rpc_port):
+        self.config = config
+        self.node_port = node_port
+        self.rpc_port = rpc_port
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            SERVER + ["run"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        self.proc.stdin.write(config)
+        self.proc.stdin.close()
+
+    def stop(self):
+        # SIGTERM-then-kill, cli.rs:43-68
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+def start_network(n):
+    ports = [(next(_ports), next(_ports)) for _ in range(n)]
+    configs = [
+        run_cli(
+            SERVER + ["config", "new", f"127.0.0.1:{np}", f"127.0.0.1:{rp}"]
+        ).stdout
+        for np, rp in ports
+    ]
+    fragments = [
+        run_cli(SERVER + ["config", "get-node"], stdin=cfg).stdout for cfg in configs
+    ]
+    servers = []
+    for i, ((np, rp), cfg) in enumerate(zip(ports, configs)):
+        full = cfg + "\n" + "\n".join(f for j, f in enumerate(fragments) if j != i)
+        servers.append(ServerProcess(full, np, rp))
+    for np, rp in ports:
+        wait_for_port(np)
+        wait_for_port(rp)
+    return servers
+
+
+@pytest.fixture
+def network_3():
+    servers = start_network(3)
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def new_wallet(rpc_port):
+    return run_cli(CLIENT + ["config", "new", f"http://127.0.0.1:{rpc_port}"]).stdout
+
+
+def wallet_pubkey(wallet):
+    return run_cli(CLIENT + ["config", "get-public-key"], stdin=wallet).stdout.strip()
+
+
+def get_balance(wallet):
+    return int(run_cli(CLIENT + ["get-balance"], stdin=wallet).stdout)
+
+
+def get_last_sequence(wallet):
+    return int(run_cli(CLIENT + ["get-last-sequence"], stdin=wallet).stdout)
+
+
+def wait_for_sequence(wallet, seq):
+    # lib.sh:92-101
+    deadline = time.monotonic() + TIMEOUT
+    while time.monotonic() < deadline:
+        if get_last_sequence(wallet) == seq:
+            return
+        time.sleep(TICK)
+    raise TimeoutError(f"sequence {seq} not reached")
+
+
+class TestConfigPlumbing:
+    def test_server_config_roundtrip(self):
+        cfg = run_cli(SERVER + ["config", "new", "127.0.0.1:1", "127.0.0.1:2"]).stdout
+        fragment = run_cli(SERVER + ["config", "get-node"], stdin=cfg).stdout
+        assert '[[nodes]]' in fragment
+        assert 'address = "127.0.0.1:1"' in fragment
+
+    def test_client_config_roundtrip(self):
+        wallet = new_wallet(9)
+        pubkey = wallet_pubkey(wallet)
+        assert len(bytes.fromhex(pubkey)) == 32
+
+    def test_double_bind_fails(self):
+        # cli.rs:133-160: second server on the same ports must exit nonzero
+        np, rp = next(_ports), next(_ports)
+        cfg = run_cli(
+            SERVER + ["config", "new", f"127.0.0.1:{np}", f"127.0.0.1:{rp}"]
+        ).stdout
+        first = ServerProcess(cfg, np, rp)
+        try:
+            wait_for_port(np)
+            cfg2 = run_cli(
+                SERVER + ["config", "new", f"127.0.0.1:{np}", f"127.0.0.1:{rp}"]
+            ).stdout
+            second = ServerProcess(cfg2, np, rp)
+            assert second.proc.wait(timeout=TIMEOUT) != 0
+        finally:
+            first.stop()
+
+    def test_dns_names_resolve(self):
+        # server-config-resolve-addrs parity: localhost:port works standalone
+        np, rp = next(_ports), next(_ports)
+        cfg = run_cli(
+            SERVER + ["config", "new", f"localhost:{np}", f"localhost:{rp}"]
+        ).stdout
+        server = ServerProcess(cfg, np, rp)
+        try:
+            wait_for_port(np)
+            wait_for_port(rp)
+            wallet = new_wallet(rp)
+            assert get_balance(wallet) == 100_000
+        finally:
+            server.stop()
+
+
+class TestNetworkE2E:
+    def test_transfer_conservation(self, network_3):
+        rpc = network_3[0].rpc_port
+        sender, receiver = new_wallet(rpc), new_wallet(rpc)
+        recv_pub = wallet_pubkey(receiver)
+        run_cli(CLIENT + ["send-asset", "1", recv_pub, "100"], stdin=sender)
+        wait_for_sequence(sender, 1)
+        assert get_balance(sender) == 99_900
+        assert get_balance(receiver) == 100_100
+
+    def test_tx_shows_in_latest(self, network_3):
+        rpc = network_3[0].rpc_port
+        sender, receiver = new_wallet(rpc), new_wallet(rpc)
+        recv_pub = wallet_pubkey(receiver)
+        run_cli(CLIENT + ["send-asset", "1", recv_pub, "77"], stdin=sender)
+        wait_for_sequence(sender, 1)
+        out = run_cli(CLIENT + ["get-latest-transactions"], stdin=sender).stdout
+        assert "send 77¤" in out
+        assert "(success)" in out
+
+    def test_client_against_dead_server_fails(self):
+        # cli.rs:215-228
+        wallet = new_wallet(1)  # nothing listens on port 1
+        proc = run_cli(
+            CLIENT + ["send-asset", "1", "ab" * 32, "10"], stdin=wallet, check=False
+        )
+        assert proc.returncode != 0
