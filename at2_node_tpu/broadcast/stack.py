@@ -59,6 +59,10 @@ MAX_CONTENTS_PER_SLOT = 8
 # garbage-collected (delivered slots after DELIVERED_RETENTION, dead slots
 # after SLOT_MAX_AGE) so unauthenticated spam cannot grow RSS unboundedly.
 DEDUP_CAP = 1 << 20
+# Cap on live (undelivered) slots: beyond this, new slots are dropped until
+# GC or delivery frees room. Bounds RSS against spam from freshly generated
+# keypairs, which pass signature verification but never reach quorum.
+MAX_LIVE_SLOTS = 1 << 17
 DELIVERED_RETENTION = 120.0  # s after delivery before the slot compacts
 SLOT_MAX_AGE = 3600.0  # s an undelivered slot may linger
 GC_INTERVAL = 30.0
@@ -157,6 +161,7 @@ class Broadcast:
             "ready_rx": 0,
             "invalid_sig": 0,
             "delivered": 0,
+            "slots_dropped": 0,
         }
 
     async def start(self) -> None:
@@ -242,6 +247,9 @@ class Broadcast:
                 payload.sequence,
             )
             return
+        if slot not in self._slots and len(self._slots) >= MAX_LIVE_SLOTS:
+            self.stats["slots_dropped"] += 1
+            return
         state = self._slots.setdefault(slot, _SlotState())
         if chash in state.contents or len(state.contents) >= MAX_CONTENTS_PER_SLOT:
             return
@@ -283,6 +291,9 @@ class Broadcast:
             logger.warning("invalid %s signature from %s",
                            "echo" if att.phase == ECHO else "ready",
                            att.origin.hex()[:16])
+            return
+        if slot not in self._slots and len(self._slots) >= MAX_LIVE_SLOTS:
+            self.stats["slots_dropped"] += 1
             return
         state = self._slots.setdefault(slot, _SlotState())
         by_origin = state.echo_by_origin if att.phase == ECHO else state.ready_by_origin

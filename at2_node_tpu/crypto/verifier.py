@@ -131,7 +131,9 @@ class TpuBatchVerifier:
         self._queue.append(
             _Pending(public_key, message, signature, fut, time.monotonic())
         )
-        if len(self._queue) >= self.batch_size:
+        # Wake the flusher on the empty->non-empty transition too, so a lone
+        # request waits max_delay, not the flusher's 100ms idle-poll tick.
+        if len(self._queue) == 1 or len(self._queue) >= self.batch_size:
             self._wakeup.set()
         return await fut
 
@@ -174,18 +176,23 @@ class TpuBatchVerifier:
             )
             await self._dispatch(batch)
 
-    async def _dispatch(self, batch: List[_Pending]) -> None:
+    def _run_batch(self, pks, msgs, sigs, bucket) -> np.ndarray:
+        """One device dispatch; subclasses (e.g. parallel.pool.PoolVerifier)
+        override this to shard the batch over a mesh."""
         from ..ops import ed25519 as kernel
 
+        return kernel.verify_batch(pks, msgs, sigs, batch_size=bucket)
+
+    async def _dispatch(self, batch: List[_Pending]) -> None:
         bucket = self._bucket_for(len(batch))
         loop = asyncio.get_running_loop()
 
         def run() -> np.ndarray:
-            return kernel.verify_batch(
+            return self._run_batch(
                 [p.public_key for p in batch],
                 [p.message for p in batch],
                 [p.signature for p in batch],
-                batch_size=bucket,
+                bucket,
             )
 
         try:
@@ -214,9 +221,14 @@ class TpuBatchVerifier:
 
 
 def make_verifier(kind: str, **kwargs) -> Verifier:
-    """Config-driven verifier selection (``verifier = "cpu" | "tpu"``)."""
+    """Config-driven verifier selection
+    (``verifier = "cpu" | "tpu" | "pool"``)."""
     if kind == "cpu":
         return CpuVerifier(**kwargs)
     if kind == "tpu":
         return TpuBatchVerifier(**kwargs)
+    if kind == "pool":
+        from ..parallel.pool import PoolVerifier
+
+        return PoolVerifier(**kwargs)
     raise ValueError(f"unknown verifier kind: {kind!r}")

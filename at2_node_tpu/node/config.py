@@ -37,11 +37,14 @@ class VerifierConfig:
     def make(self):
         from ..crypto.verifier import make_verifier
 
-        if self.kind == "tpu":
-            return make_verifier(
-                "tpu", batch_size=self.batch_size, max_delay=self.max_delay
-            )
-        return make_verifier("cpu")
+        # Route every kind through make_verifier so "pool" works from
+        # config and an unknown kind raises instead of silently degrading
+        # the north-star path to per-signature CPU verification.
+        if self.kind == "cpu":
+            return make_verifier("cpu")
+        return make_verifier(
+            self.kind, batch_size=self.batch_size, max_delay=self.max_delay
+        )
 
 
 @dataclass

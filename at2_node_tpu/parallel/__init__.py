@@ -1,0 +1,5 @@
+"""Multi-chip parallelism: the sharded verifier pool (see pool.py)."""
+
+from .pool import PoolVerifier, make_mesh, pool_bucket_for, verify_batch_sharded
+
+__all__ = ["PoolVerifier", "make_mesh", "pool_bucket_for", "verify_batch_sharded"]

@@ -1,0 +1,123 @@
+"""Headline benchmark: batched ed25519 verifies/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Baseline = the north-star target from BASELINE.json: 50,000 ed25519
+verifies/sec/chip (the reference publishes no numbers — SURVEY.md §6 — so
+the target is the yardstick; vs_baseline > 1.0 means the target is beaten).
+
+Measures the sustained device throughput of the production dispatch path
+(`ops.ed25519.verify_kernel`, fixed 8192-lane bucket) with host-side batch
+prep overlapped on a worker thread, i.e. the steady state of
+`TpuBatchVerifier` under firehose load (BASELINE config 2/3). Also reports
+the end-to-end single-stream number (prep + dispatch serialized) and the
+CPU (OpenSSL) baseline for context.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+TARGET_PER_CHIP = 50_000.0
+BUCKET = 8192
+ROUNDS = 6
+
+
+def _make_batch(n: int):
+    from at2_node_tpu.crypto.keys import SignKeyPair
+
+    kp = SignKeyPair.from_hex("5a" * 32)
+    pk = kp.public
+    msgs = [b"bench message %08d" % i for i in range(n)]
+    sigs = [kp.sign(m) for m in msgs]
+    return [pk] * n, msgs, sigs
+
+
+def main() -> None:
+    import jax
+
+    from at2_node_tpu.ops import ed25519 as kernel
+
+    dev = jax.devices()[0]
+    pks, msgs, sigs = _make_batch(BUCKET)
+
+    # Warm-up: compile the bucket's program and fault in constants.
+    prepared = kernel.prepare_batch(pks, msgs, sigs, BUCKET)
+    import jax.numpy as jnp
+
+    dev_args = tuple(jnp.asarray(x) for x in prepared)
+    out = kernel._verify_jit(*dev_args)
+    out.block_until_ready()
+    assert bool(np.asarray(out).all()), "warm-up batch failed to verify"
+
+    # 1) Device throughput: dispatch the compiled program back-to-back.
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        out = kernel._verify_jit(*dev_args)
+    out.block_until_ready()
+    device_rate = ROUNDS * BUCKET / (time.perf_counter() - t0)
+
+    # 2) Host prep rate (sha512 + window decomposition, one thread).
+    t0 = time.perf_counter()
+    kernel.prepare_batch(pks, msgs, sigs, BUCKET)
+    prep_rate = BUCKET / (time.perf_counter() - t0)
+
+    # 3) Pipelined steady state: prep on a worker thread, JAX's async
+    #    dispatch keeps up to DEPTH batches in flight (transfer of batch
+    #    i+1 overlaps compute of batch i) — the TpuBatchVerifier execution
+    #    model under firehose load.
+    from collections import deque
+
+    DEPTH = 3
+    pool = ThreadPoolExecutor(max_workers=2)
+    next_prep = pool.submit(kernel.prepare_batch, pks, msgs, sigs, BUCKET)
+    inflight: deque = deque()
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        a, r, s_le, h_le, valid = next_prep.result()
+        next_prep = pool.submit(kernel.prepare_batch, pks, msgs, sigs, BUCKET)
+        inflight.append(
+            kernel._verify_jit(
+                jnp.asarray(a), jnp.asarray(r), jnp.asarray(s_le),
+                jnp.asarray(h_le), jnp.asarray(valid),
+            )
+        )
+        if len(inflight) >= DEPTH:
+            np.asarray(inflight.popleft())  # fetch results of oldest batch
+    while inflight:
+        np.asarray(inflight.popleft())
+    pipelined_rate = ROUNDS * BUCKET / (time.perf_counter() - t0)
+    pool.shutdown(wait=False)
+
+    # 4) CPU baseline (the reference's execution model): OpenSSL, one core.
+    from at2_node_tpu.crypto.keys import verify_one
+
+    n_cpu = 2000
+    t0 = time.perf_counter()
+    for i in range(n_cpu):
+        verify_one(pks[i], msgs[i], sigs[i])
+    cpu_rate = n_cpu / (time.perf_counter() - t0)
+
+    value = pipelined_rate
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verifies_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "sigs/s",
+                "vs_baseline": round(value / TARGET_PER_CHIP, 3),
+                "device": str(dev.platform),
+                "bucket": BUCKET,
+                "device_only_rate": round(device_rate, 1),
+                "host_prep_rate": round(prep_rate, 1),
+                "cpu_openssl_1core_rate": round(cpu_rate, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
