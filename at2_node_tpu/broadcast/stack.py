@@ -59,9 +59,11 @@ MAX_CONTENTS_PER_SLOT = 8
 # garbage-collected (delivered slots after DELIVERED_RETENTION, dead slots
 # after SLOT_MAX_AGE) so unauthenticated spam cannot grow RSS unboundedly.
 DEDUP_CAP = 1 << 20
-# Cap on live (undelivered) slots: beyond this, new slots are dropped until
-# GC or delivery frees room. Bounds RSS against spam from freshly generated
+# Cap on undelivered slots: beyond this, new slots are dropped until
+# delivery or GC frees room. Bounds RSS against spam from freshly generated
 # keypairs, which pass signature verification but never reach quorum.
+# Delivered slots retained for DELIVERED_RETENTION deliberately do NOT
+# count: sustained legitimate throughput must never trip the cap.
 MAX_LIVE_SLOTS = 1 << 17
 DELIVERED_RETENTION = 120.0  # s after delivery before the slot compacts
 SLOT_MAX_AGE = 3600.0  # s an undelivered slot may linger
@@ -154,6 +156,8 @@ class Broadcast:
         self._attest_seen = _BoundedSet(DEDUP_CAP)
         # slots compacted away after delivery; membership blocks re-delivery
         self._delivered_slots = _BoundedSet(DEDUP_CAP)
+        # count of slots in _slots with delivered == False (the cap metric)
+        self._undelivered = 0
         # observability counters (SURVEY.md §5: per-stage counters)
         self.stats = {
             "gossip_rx": 0,
@@ -208,6 +212,8 @@ class Broadcast:
                     self._delivered_slots.add(slot)
                     del self._slots[slot]
                 elif age > SLOT_MAX_AGE:
+                    if not state.delivered:
+                        self._undelivered -= 1
                     del self._slots[slot]
 
     async def _worker(self) -> None:
@@ -247,10 +253,10 @@ class Broadcast:
                 payload.sequence,
             )
             return
-        if slot not in self._slots and len(self._slots) >= MAX_LIVE_SLOTS:
+        if slot not in self._slots and self._undelivered >= MAX_LIVE_SLOTS:
             self.stats["slots_dropped"] += 1
             return
-        state = self._slots.setdefault(slot, _SlotState())
+        state = self._new_or_existing_slot(slot)
         if chash in state.contents or len(state.contents) >= MAX_CONTENTS_PER_SLOT:
             return
         state.contents[chash] = payload
@@ -292,10 +298,10 @@ class Broadcast:
                            "echo" if att.phase == ECHO else "ready",
                            att.origin.hex()[:16])
             return
-        if slot not in self._slots and len(self._slots) >= MAX_LIVE_SLOTS:
+        if slot not in self._slots and self._undelivered >= MAX_LIVE_SLOTS:
             self.stats["slots_dropped"] += 1
             return
-        state = self._slots.setdefault(slot, _SlotState())
+        state = self._new_or_existing_slot(slot)
         by_origin = state.echo_by_origin if att.phase == ECHO else state.ready_by_origin
         if att.origin in by_origin:
             return
@@ -303,6 +309,13 @@ class Broadcast:
         votes = state.echoes if att.phase == ECHO else state.readies
         votes[att.content_hash].add(att.origin)
         self._advance(slot, state, att.content_hash)
+
+    def _new_or_existing_slot(self, slot: Slot) -> _SlotState:
+        state = self._slots.get(slot)
+        if state is None:
+            state = self._slots[slot] = _SlotState()
+            self._undelivered += 1
+        return state
 
     # -- state transitions (synchronous; no awaits) -----------------------
 
@@ -343,5 +356,6 @@ class Broadcast:
             and chash in state.contents
         ):
             state.delivered = True
+            self._undelivered -= 1
             self.stats["delivered"] += 1
             self.delivered.put_nowait(state.contents[chash])

@@ -34,7 +34,15 @@ BATCH_AXIS = "batch"
 # (mesh, bucket) pair, mirroring the fixed-bucket policy of the single-chip
 # path (ops.ed25519.BUCKETS).
 _SHARDED_VERIFY: dict = {}
+_SHARDED_PALLAS: dict = {}
 _SHARDED_COUNT: dict = {}
+
+
+def _pallas_on_mesh() -> bool:
+    """On real TPU hardware the pool shards the Pallas kernel (the fast
+    path); on the CPU virtual mesh it shards the XLA graph (Pallas has no
+    compiled CPU lowering)."""
+    return jax.default_backend() == "tpu"
 
 
 def make_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
@@ -62,6 +70,33 @@ def _verify_fn(mesh: Mesh):
     return fn
 
 
+def _pallas_fn(mesh: Mesh):
+    """shard_map of the Pallas verify graph: each chip runs the kernel on
+    its batch shard; no cross-chip communication."""
+    fn = _SHARDED_PALLAS.get(mesh)
+    if fn is None:
+        from functools import partial
+
+        from jax.experimental.shard_map import shard_map
+
+        from ..ops.pallas_verify import verify_graph
+
+        spec = PartitionSpec(BATCH_AXIS)
+        fn = jax.jit(
+            shard_map(
+                partial(verify_graph),
+                mesh=mesh,
+                in_specs=(spec,) * 5,
+                out_specs=spec,
+                # pallas_call outputs carry no varying-mesh-axes metadata;
+                # the graph is purely batch-elementwise, so this is safe
+                check_rep=False,
+            )
+        )
+        _SHARDED_PALLAS[mesh] = fn
+    return fn
+
+
 def _count_fn(mesh: Mesh):
     """verify + replicated valid-count: the scalar reduction is the one
     cross-chip collective (psum over ICI, inserted by XLA from the
@@ -84,19 +119,31 @@ def _count_fn(mesh: Mesh):
     return fn
 
 
-def pool_bucket_for(n: int, n_devices: int) -> int:
+def pool_bucket_for(n: int, n_devices: int, quantum: int | None = None) -> int:
     """Smallest bucket that fits n and splits evenly across the mesh.
 
-    Buckets that don't divide the device count are rounded up to the next
-    multiple, so the set of compiled shapes stays fixed per mesh size (no
-    recompiles on traffic jitter, same policy as the single-chip path).
+    ``quantum`` is the required divisor of the bucket (defaults to the
+    device count; the Pallas path needs device_count * TILE so each chip's
+    shard fills whole kernel tiles). Buckets are rounded up to the next
+    quantum multiple, so the set of compiled shapes stays fixed per mesh
+    size (no recompiles on traffic jitter, same policy as the single-chip
+    path).
     """
+    q = quantum if quantum is not None else n_devices
     for b in kernel.BUCKETS:
-        b = ((b + n_devices - 1) // n_devices) * n_devices
+        b = ((b + q - 1) // q) * q
         if n <= b:
             return b
     top = max(kernel.BUCKETS[-1], n)
-    return ((top + n_devices - 1) // n_devices) * n_devices
+    return ((top + q - 1) // q) * q
+
+
+def _pool_quantum(n_devices: int) -> int:
+    if _pallas_on_mesh():
+        from ..ops.pallas_verify import TILE
+
+        return n_devices * TILE
+    return n_devices
 
 
 def verify_batch_sharded(
@@ -110,18 +157,23 @@ def verify_batch_sharded(
     if mesh is None:
         mesh = make_mesh()
     n_dev = mesh.devices.size
+    quantum = _pool_quantum(n_dev)
     if batch_size is None:
-        batch_size = pool_bucket_for(len(public_keys), n_dev)
-    if batch_size % n_dev != 0:
-        raise ValueError(f"batch_size {batch_size} not divisible by {n_dev} devices")
-    a, r, s_w, h_w, valid = kernel.prepare_batch(
+        batch_size = pool_bucket_for(len(public_keys), n_dev, quantum)
+    if batch_size % quantum != 0:
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by pool quantum {quantum}"
+            f" ({n_dev} devices)"
+        )
+    a, r, s_le, h_le, valid = kernel.prepare_batch(
         public_keys, messages, signatures, batch_size
     )
-    out = _verify_fn(mesh)(
+    fn = _pallas_fn(mesh) if _pallas_on_mesh() else _verify_fn(mesh)
+    out = fn(
         jnp.asarray(a),
         jnp.asarray(r),
-        jnp.asarray(s_w),
-        jnp.asarray(h_w),
+        jnp.asarray(s_le),
+        jnp.asarray(h_le),
         jnp.asarray(valid),
     )
     return np.asarray(out)[: len(public_keys)]
@@ -145,10 +197,12 @@ class PoolVerifier(TpuBatchVerifier):
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
         # Every bucket (and the batch_size TpuBatchVerifier unions in) must
-        # split evenly across the mesh: round both up to device multiples.
-        batch_size = ((batch_size + n_dev - 1) // n_dev) * n_dev
+        # split evenly across the mesh — into whole Pallas tiles per chip
+        # on hardware: round both up to quantum multiples.
+        q = _pool_quantum(n_dev)
+        batch_size = ((batch_size + q - 1) // q) * q
         buckets = tuple(
-            sorted({pool_bucket_for(b, n_dev) for b in kernel.BUCKETS})
+            sorted({pool_bucket_for(b, n_dev, q) for b in kernel.BUCKETS})
         )
         super().__init__(
             batch_size=batch_size, max_delay=max_delay, buckets=buckets
