@@ -1,0 +1,13 @@
+"""Native (C++) host-side batch preparation for the TPU verifier.
+
+The runtime around the TPU compute path is native where the reference's
+is (its whole broadcast/crypto stack is Rust): `at2_prep.cpp` implements
+SHA-512, the mod-L scalar reduction, the S < L check, and batch packing,
+compiled on first use with the system g++ into a shared library loaded
+via ctypes (no pybind11 in this image). Falls back to the pure-Python
+path transparently if compilation fails.
+"""
+
+from .prep import native_available, prep_batch_native
+
+__all__ = ["native_available", "prep_batch_native"]

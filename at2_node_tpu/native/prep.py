@@ -1,0 +1,147 @@
+"""ctypes bindings for the native batch-prep library (at2_prep.cpp).
+
+Build-on-first-use: the .so is compiled with g++ into this package's
+``build/`` directory and cached by source mtime. Loading or building can
+fail (no compiler, read-only tree); callers must check
+:func:`native_available` and fall back to the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "at2_prep.cpp")
+_BUILD_DIR = os.path.join(_HERE, "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libat2prep.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+        return _LIB_PATH
+    # per-process temp name: concurrent first-use builds in separate
+    # processes must not promote each other's half-written output
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
+        return _LIB_PATH
+    except Exception as exc:  # missing g++, sandboxed fs, ...
+        logger.warning("native prep build failed (%s); using python path", exc)
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as exc:
+            logger.warning("native prep load failed (%s)", exc)
+            return None
+        lib.at2_prep_batch.argtypes = [
+            _U8P, _U64P, _U8P, _U64P, _U8P, _U64P,
+            ctypes.c_int64, ctypes.c_int64,
+            _U8P, _U8P, _U8P, _U8P, _U8P,
+        ]
+        lib.at2_prep_batch.restype = None
+        lib.at2_sha512.argtypes = [_U8P, ctypes.c_int64, _U8P]
+        lib.at2_sha512.restype = None
+        lib.at2_mod_l.argtypes = [_U8P, _U8P]
+        lib.at2_mod_l.restype = None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _pack(chunks: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    offsets = np.zeros(len(chunks) + 1, dtype=np.uint64)
+    np.cumsum([len(c) for c in chunks], out=offsets[1:])
+    flat = np.frombuffer(b"".join(chunks), dtype=np.uint8) if chunks else np.zeros(0, np.uint8)
+    return flat, offsets
+
+
+def _ptr8(a: np.ndarray):
+    return a.ctypes.data_as(_U8P)
+
+
+def prep_batch_native(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    batch_size: int,
+    n_threads: int = 0,
+):
+    """Native equivalent of ops.ed25519.prepare_batch (same contract)."""
+    lib = _load()
+    assert lib is not None, "call native_available() first"
+    n = len(public_keys)
+    if n > batch_size:
+        raise ValueError(f"batch of {n} exceeds bucket size {batch_size}")
+    pk_flat, pk_off = _pack(public_keys)
+    msg_flat, msg_off = _pack(messages)
+    sig_flat, sig_off = _pack(signatures)
+
+    a = np.zeros((batch_size, 32), dtype=np.uint8)
+    r = np.zeros((batch_size, 32), dtype=np.uint8)
+    s = np.zeros((batch_size, 32), dtype=np.uint8)
+    h = np.zeros((batch_size, 32), dtype=np.uint8)
+    valid8 = np.zeros(batch_size, dtype=np.uint8)
+    if n_threads <= 0:
+        n_threads = os.cpu_count() or 1
+    lib.at2_prep_batch(
+        _ptr8(pk_flat), pk_off.ctypes.data_as(_U64P),
+        _ptr8(msg_flat), msg_off.ctypes.data_as(_U64P),
+        _ptr8(sig_flat), sig_off.ctypes.data_as(_U64P),
+        n, n_threads,
+        _ptr8(a), _ptr8(r), _ptr8(s), _ptr8(h), _ptr8(valid8),
+    )
+    return a, r, s, h, valid8.astype(bool)
+
+
+def sha512_native(data: bytes) -> bytes:
+    lib = _load()
+    assert lib is not None
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(0, np.uint8)
+    out = np.zeros(64, dtype=np.uint8)
+    lib.at2_sha512(_ptr8(buf), len(data), _ptr8(out))
+    return out.tobytes()
+
+
+def mod_l_native(digest64: bytes) -> int:
+    lib = _load()
+    assert lib is not None
+    buf = np.frombuffer(digest64, dtype=np.uint8)
+    out = np.zeros(32, dtype=np.uint8)
+    lib.at2_mod_l(_ptr8(buf), _ptr8(out))
+    return int.from_bytes(out.tobytes(), "little")

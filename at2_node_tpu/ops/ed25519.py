@@ -89,12 +89,32 @@ def prepare_batch(
     Padded to ``batch_size`` when given. ``valid`` is False for malformed
     inputs (bad lengths, S >= L) and for padding lanes; the kernel ANDs it
     into its result, so padding verifies as False without branching.
+
+    Dispatches to the native C++ path (`at2_node_tpu.native`, ~6x faster
+    per core) when its library is available; this Python loop is the
+    fallback and differential reference.
     """
     n = len(public_keys)
     size = batch_size if batch_size is not None else n
     if n > size:
         raise ValueError(f"batch of {n} exceeds bucket size {size}")
 
+    from ..native import native_available, prep_batch_native
+
+    if native_available():
+        return prep_batch_native(public_keys, messages, signatures, size)
+    return prepare_batch_py(public_keys, messages, signatures, size)
+
+
+def prepare_batch_py(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    size: int,
+):
+    """Pure-Python prepare_batch: the fallback when the native library is
+    unavailable and the differential reference for it."""
+    n = len(public_keys)
     a_bytes = np.zeros((size, 32), dtype=np.uint8)
     r_bytes = np.zeros((size, 32), dtype=np.uint8)
     s_le = np.zeros((size, 32), dtype=np.uint8)

@@ -385,7 +385,11 @@ def verify_batch_pallas(
         # interpreter: no bucket padding — every padded lane costs real
         # CPU time; hardware: fixed buckets to avoid recompiles
         batch_size = n if interpret else bucket_for(n)
-    batch_size = max(batch_size, tile, n)
+    elif n > batch_size:
+        # same contract as prepare_batch: an explicit bucket is a promise,
+        # not a hint — silently growing it would recompile per distinct n
+        raise ValueError(f"batch of {n} exceeds bucket size {batch_size}")
+    batch_size = max(batch_size, tile)
     if batch_size % tile:
         batch_size = ((batch_size + tile - 1) // tile) * tile
     a, r, s_le, h_le, valid = prepare_batch(

@@ -41,8 +41,8 @@ _SHARDED_COUNT: dict = {}
 def _pallas_on_mesh() -> bool:
     """On real TPU hardware the pool shards the Pallas kernel (the fast
     path); on the CPU virtual mesh it shards the XLA graph (Pallas has no
-    compiled CPU lowering)."""
-    return jax.default_backend() == "tpu"
+    compiled CPU lowering). Single source of truth: ed25519._use_pallas."""
+    return kernel._use_pallas()
 
 
 def make_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
@@ -75,8 +75,6 @@ def _pallas_fn(mesh: Mesh):
     its batch shard; no cross-chip communication."""
     fn = _SHARDED_PALLAS.get(mesh)
     if fn is None:
-        from functools import partial
-
         from jax.experimental.shard_map import shard_map
 
         from ..ops.pallas_verify import verify_graph
@@ -84,7 +82,7 @@ def _pallas_fn(mesh: Mesh):
         spec = PartitionSpec(BATCH_AXIS)
         fn = jax.jit(
             shard_map(
-                partial(verify_graph),
+                verify_graph,
                 mesh=mesh,
                 in_specs=(spec,) * 5,
                 out_specs=spec,

@@ -1,0 +1,75 @@
+"""Native (C++) batch-prep library: differential tests against hashlib and
+the pure-Python prepare_batch (the contract reference).
+
+The library builds on first use with the system g++; if that fails the
+whole framework transparently uses the Python path, so these tests skip
+rather than fail when no toolchain is present.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.ops import ed25519 as kernel
+from at2_node_tpu.native.prep import (
+    mod_l_native,
+    native_available,
+    prep_batch_native,
+    sha512_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native prep library unavailable"
+)
+
+RNG = random.Random(0xBEEF)
+
+
+def test_sha512_differential():
+    for n in (0, 1, 63, 64, 111, 112, 127, 128, 129, 255, 4096):
+        data = RNG.randbytes(n)
+        assert sha512_native(data) == hashlib.sha512(data).digest()
+
+
+def test_mod_l_differential():
+    L = kernel.L
+    cases = [0, 1, L - 1, L, L + 1, 2 * L, 2 * L - 1, (1 << 512) - 1,
+             1 << 252, (1 << 252) - 1, (1 << 448), (1 << 448) - 1]
+    cases += [RNG.getrandbits(512) for _ in range(2000)]
+    cases += [RNG.getrandbits(bits) for bits in range(0, 512, 7)]
+    for v in cases:
+        assert mod_l_native(v.to_bytes(64, "little")) == v % L
+
+
+def test_prep_batch_matches_python():
+    kp = SignKeyPair.from_hex("77" * 32)
+    n = 200
+    msgs = [b"prep parity %d" % i for i in range(n)]
+    sigs = [kp.sign(m) for m in msgs]
+    pks = [kp.public] * n
+    # malformed/edge lanes
+    pks[1] = pks[1][:31]
+    sigs[2] = sigs[2][:63]
+    s = int.from_bytes(sigs[3][32:], "little")
+    sigs[3] = sigs[3][:32] + (s + kernel.L).to_bytes(32, "little")
+    sigs[4] = sigs[4][:32] + (kernel.L - 1).to_bytes(32, "little")  # in range
+    msgs[5] = b""
+
+    py = kernel.prepare_batch_py(pks, msgs, sigs, 256)
+    nat = prep_batch_native(pks, msgs, sigs, 256)
+    for p, q, name in zip(py, nat, ("a", "r", "s", "h", "valid")):
+        assert np.array_equal(p, q), name
+
+
+def test_prep_batch_variable_length_messages():
+    kp = SignKeyPair.from_hex("78" * 32)
+    msgs = [RNG.randbytes(RNG.randrange(0, 300)) for _ in range(50)]
+    sigs = [kp.sign(m) for m in msgs]
+    pks = [kp.public] * 50
+    py = kernel.prepare_batch_py(pks, msgs, sigs, 64)
+    nat = prep_batch_native(pks, msgs, sigs, 64)
+    for p, q in zip(py, nat):
+        assert np.array_equal(p, q)
