@@ -33,9 +33,6 @@ _U64P = ctypes.POINTER(ctypes.c_uint64)
 
 
 def _build() -> Optional[str]:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
-        return _LIB_PATH
     # per-process temp name: concurrent first-use builds in separate
     # processes must not promote each other's half-written output
     tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
@@ -44,10 +41,13 @@ def _build() -> Optional[str]:
         _SRC, "-o", tmp,
     ]
     try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+            return _LIB_PATH
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB_PATH)
         return _LIB_PATH
-    except Exception as exc:  # missing g++, sandboxed fs, ...
+    except Exception as exc:  # missing g++, read-only tree, missing source
         logger.warning("native prep build failed (%s); using python path", exc)
         return None
 

@@ -1,0 +1,178 @@
+"""Aggregate (quorum-certificate) signature verification on TPU.
+
+BASELINE.json config 4: "Echo-quorum certificate aggregate verify (n=64
+replicas, f=21) — one MSM per quorum". A quorum certificate is n
+attestations from distinct replicas; instead of n independent RFC 8032
+checks, the whole certificate is verified with ONE curve equation via the
+standard random-linear-combination batch check:
+
+    [sum_i z_i S_i mod L] B  ==  sum_i [z_i] R_i  +  sum_i [z_i h_i] A_i
+
+with fresh random 128-bit z_i per call. If every signature is valid the
+equation always holds; if any is invalid it holds with probability
+<= 2^-128 over the z_i. A False result says "some signature is bad", so
+callers fall back to individual verification to find culprits (the
+reference has no aggregate path at all — every Echo/Ready is checked
+one-by-one [dep-inferred from /root/reference/technical.md:11-15]).
+
+TPU mapping: per-lane Straus computes T_i = [z_i]R_i + [z_i h_i]A_i for
+all lanes at once (both points variable — generalizes
+edwards.double_scalar_mul_vs_base), then a log2(n)-step tree of batched
+point additions folds the lanes to a single point — no scatters, no
+Pippenger buckets, every step a full-width vector op.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ed25519 as base
+from . import edwards as ed
+from . import field as fe
+
+Z_BITS = 128
+
+
+def _windows_from_int(k: int) -> np.ndarray:
+    le = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+    return base._windows_msb_first(le[None, :])[0]
+
+
+def double_scalar_mul(p_point, p_windows, q_point, q_windows):
+    """[a]P + [b]Q with both points variable (batched Straus, 4-bit
+    windows); the vs_base variant in `edwards` is the special case Q = B."""
+    table_p = ed.build_table(p_point)
+    table_q = ed.build_table(q_point)
+    batch_shape = p_windows.shape[:-1]
+    acc0 = jnp.broadcast_to(
+        jnp.asarray(ed.IDENTITY), batch_shape + (4, fe.N_LIMBS)
+    )
+
+    def body(w, acc):
+        acc = ed.double(ed.double(ed.double(ed.double(acc))))
+        acc = ed.add(acc, ed._lookup(table_p, p_windows[..., w]))
+        acc = ed.add(acc, ed._lookup(table_q, q_windows[..., w]))
+        return acc
+
+    return jax.lax.fori_loop(0, base.N_WINDOWS, body, acc0)
+
+
+def tree_reduce_points(pts: jnp.ndarray) -> jnp.ndarray:
+    """Sum a (B, 4, 20) stack of points into one point with log2(B)
+    halving rounds of batched additions (B must be a power of two)."""
+    n = pts.shape[0]
+    while n > 1:
+        half = n // 2
+        pts = ed.add(pts[:half], pts[half : 2 * half])
+        n = half
+    return pts[0]
+
+
+def _aggregate_graph(r_bytes, a_bytes, z_win, zh_win, zs_win, valid):
+    """Jittable check of the RLC equation; returns scalar bool."""
+    a_point, a_ok = ed.decompress(a_bytes)
+    r_point, r_ok = ed.decompress(r_bytes)
+    t = double_scalar_mul(r_point, z_win, a_point, zh_win)
+    # invalid lanes (padding) contribute the identity
+    ident = jnp.asarray(ed.IDENTITY)
+    t = jnp.where(valid[:, None, None], t, ident)
+    q = tree_reduce_points(t)
+    # [zs]B via the vs_base Straus with zero variable-scalar
+    zero_win = jnp.zeros_like(zs_win)
+    lhs = ed.double_scalar_mul_vs_base(
+        jnp.asarray(ed.IDENTITY)[None], zero_win[None], zs_win[None]
+    )[0]
+    # projective equality lhs == q
+    eq = fe.eq(
+        fe.mul(lhs[ed.X], q[ed.Z]), fe.mul(q[ed.X], lhs[ed.Z])
+    ) & fe.eq(fe.mul(lhs[ed.Y], q[ed.Z]), fe.mul(q[ed.Y], lhs[ed.Z]))
+    return eq & jnp.all(a_ok | ~valid) & jnp.all(r_ok | ~valid)
+
+
+_aggregate_jit = jax.jit(_aggregate_graph)
+
+
+def aggregate_verify(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    _z_override: Sequence[int] | None = None,
+) -> bool:
+    """One-equation verification of a whole certificate (True = all valid).
+
+    ``_z_override`` fixes the random coefficients (tests only — with
+    adversarially known z the soundness argument does not hold).
+    """
+    n = len(public_keys)
+    if n == 0:
+        return True
+    # host prep: h_i, range checks (native path when available)
+    a, r, s_le, h_le, valid = base.prepare_batch(
+        public_keys, messages, signatures, batch_size=None
+    )
+    if not valid[:n].all():
+        return False  # malformed input can never verify
+
+    z = list(_z_override) if _z_override is not None else [
+        secrets.randbits(Z_BITS) | 1 for _ in range(n)
+    ]
+    h_ints = [int.from_bytes(h_le[i].tobytes(), "little") for i in range(n)]
+    s_ints = [int.from_bytes(s_le[i].tobytes(), "little") for i in range(n)]
+    zh = [(zi * hi) % base.L for zi, hi in zip(z, h_ints)]
+    zs = sum(zi * si for zi, si in zip(z, s_ints)) % base.L
+
+    # pad lanes to a power of two for the reduction tree
+    size = 1 << (n - 1).bit_length()
+    pad = np.zeros((size, 32), dtype=np.uint8)
+
+    def padded(rows):
+        out = pad.copy()
+        out[:n] = rows[:n]
+        return out
+
+    z_win = np.zeros((size, base.N_WINDOWS), dtype=np.int32)
+    zh_win = np.zeros((size, base.N_WINDOWS), dtype=np.int32)
+    for i in range(n):
+        z_win[i] = _windows_from_int(z[i])
+        zh_win[i] = _windows_from_int(zh[i])
+    valid_pad = np.zeros(size, dtype=bool)
+    valid_pad[:n] = True
+
+    ok = _aggregate_jit(
+        jnp.asarray(padded(r)),
+        jnp.asarray(padded(a)),
+        jnp.asarray(z_win),
+        jnp.asarray(zh_win),
+        jnp.asarray(_windows_from_int(zs)),
+        jnp.asarray(valid_pad),
+    )
+    return bool(ok)
+
+
+def verify_certificate(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> np.ndarray:
+    """Certificate verification, fastest available route. Returns (n,) bool.
+
+    On TPU the Pallas per-signature kernel verifies a 64-attestation
+    certificate in well under a millisecond — faster than the one-MSM
+    aggregate equation evaluated through the XLA graph — so it IS the fast
+    path there and reports per-signature verdicts directly. Off-TPU the
+    RLC aggregate check runs first (one equation for the whole
+    certificate, the BASELINE config-4 shape) with individual fallback to
+    pinpoint culprits.
+    """
+    n = len(public_keys)
+    if base._use_pallas():
+        return base.verify_batch(public_keys, messages, signatures)
+    if aggregate_verify(public_keys, messages, signatures):
+        return np.ones(n, dtype=bool)
+    return base.verify_batch(public_keys, messages, signatures)

@@ -1,0 +1,1 @@
+"""Operational tooling: load generation and benchmark harnesses."""

@@ -1,0 +1,166 @@
+"""send-asset firehose load generator (BASELINE.json config 3).
+
+Drives an AT2 network the way the reference's shell tests do — real gRPC
+`SendAsset` calls from real client identities — but at benchmark
+intensity: K concurrent clients, each with its own keypair, pipelining
+transfers with incrementing sequences, spread round-robin over the
+node RPC endpoints. Progress is measured by the ledger itself (polling
+`GetLastSequence` per sender on a node that did NOT take the writes,
+so a count only registers after broadcast totality commits it).
+
+Usage:
+    python -m at2_node_tpu.tools.loadgen \
+        --rpc http://127.0.0.1:4001 --rpc http://127.0.0.1:4003 \
+        --clients 16 --tx-per-client 100 [--window 8] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from dataclasses import dataclass
+from typing import List
+
+from ..client import Client
+from ..crypto.keys import SignKeyPair
+
+
+@dataclass
+class LoadResult:
+    clients: int
+    tx_per_client: int
+    submitted: int
+    committed: int
+    submit_seconds: float
+    commit_seconds: float
+
+    @property
+    def committed_tx_per_sec(self) -> float:
+        return self.committed / self.commit_seconds if self.commit_seconds else 0.0
+
+
+async def _client_worker(
+    uri: str, keypair: SignKeyPair, n_tx: int, window: int
+) -> int:
+    """Issue n_tx self-transfers with sequences 1..n_tx, keeping up to
+    ``window`` requests in flight (a firehose, not a lockstep loop)."""
+    sent = 0
+    window = max(window, 1)
+    async with Client(uri) as client:
+        pending: set = set()
+        for seq in range(1, n_tx + 1):
+            if len(pending) >= window:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    t.result()
+                    sent += 1
+            pending.add(
+                asyncio.create_task(
+                    client.send_asset(keypair, seq, keypair.public, 1)
+                )
+            )
+        for t in await asyncio.gather(*pending):
+            sent += 1
+    return sent
+
+
+async def _wait_committed(
+    uri: str, keypairs: List[SignKeyPair], n_tx: int, timeout: float
+) -> int:
+    """Poll a (read-side) node until every sender's last sequence reaches
+    n_tx or the timeout expires; returns total committed transactions."""
+    deadline = time.monotonic() + timeout
+    async with Client(uri) as client:
+        remaining = {kp.public: 0 for kp in keypairs}
+        while time.monotonic() < deadline:
+            for pk in list(remaining):
+                seq = await client.get_last_sequence(pk)
+                remaining[pk] = seq
+                if seq >= n_tx:
+                    del remaining[pk]
+            if not remaining:
+                return n_tx * len(keypairs)
+            await asyncio.sleep(0.1)
+        done = n_tx * len(keypairs) - sum(
+            n_tx - seq for seq in remaining.values()
+        )
+        return done
+
+
+async def run_load(
+    rpcs: List[str],
+    clients: int = 16,
+    tx_per_client: int = 100,
+    window: int = 8,
+    commit_timeout: float = 120.0,
+) -> LoadResult:
+    keypairs = [SignKeyPair.random() for _ in range(clients)]
+    t0 = time.monotonic()
+    sent = await asyncio.gather(
+        *(
+            _client_worker(rpcs[i % len(rpcs)], kp, tx_per_client, window)
+            for i, kp in enumerate(keypairs)
+        )
+    )
+    submit_s = time.monotonic() - t0
+    # read from the LAST endpoint, round-robin ensured writes went elsewhere
+    # too; totality means any node converges
+    committed = await _wait_committed(
+        rpcs[-1], keypairs, tx_per_client, commit_timeout
+    )
+    commit_s = time.monotonic() - t0
+    return LoadResult(
+        clients=clients,
+        tx_per_client=tx_per_client,
+        submitted=sum(sent),
+        committed=committed,
+        submit_seconds=submit_s,
+        commit_seconds=commit_s,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rpc", action="append", required=True,
+                    help="node RPC URL (repeat for round-robin)")
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--tx-per-client", type=int, default=100)
+    ap.add_argument("--window", type=int, default=8)
+    ap.add_argument("--commit-timeout", type=float, default=120.0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    res = asyncio.run(
+        run_load(
+            args.rpc,
+            clients=args.clients,
+            tx_per_client=args.tx_per_client,
+            window=args.window,
+            commit_timeout=args.commit_timeout,
+        )
+    )
+    if args.json:
+        print(json.dumps({
+            "clients": res.clients,
+            "submitted": res.submitted,
+            "committed": res.committed,
+            "submit_seconds": round(res.submit_seconds, 3),
+            "commit_seconds": round(res.commit_seconds, 3),
+            "committed_tx_per_sec": round(res.committed_tx_per_sec, 1),
+        }))
+    else:
+        print(
+            f"{res.committed}/{res.clients * res.tx_per_client} tx committed "
+            f"in {res.commit_seconds:.2f}s -> "
+            f"{res.committed_tx_per_sec:.0f} tx/s"
+        )
+    return 0 if res.committed == res.clients * res.tx_per_client else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

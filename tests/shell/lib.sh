@@ -1,0 +1,97 @@
+# Shared harness for the shell e2e tier: spawn a real localhost network of
+# `server` processes and drive it with the `client` CLI, all through PATH —
+# the reference's tests/lib.sh workflow rebuilt for this framework's
+# binaries (same operator pipeline: TOML over stdin/stdout, fragments
+# appended with `config get-node`).
+
+set -eu
+
+N_NODES=3
+WORK="$(mktemp -d)"
+PIDS=""
+
+# CPU backend for e2e: these scripts test protocol plumbing, not kernels
+export JAX_PLATFORMS=cpu
+
+cleanup() {
+    status=$?
+    for pid in $PIDS; do
+        kill -9 "$pid" 2>/dev/null || true
+    done
+    rm -rf "$WORK"
+    exit $status
+}
+trap cleanup EXIT INT TERM
+
+wait_for_port_connect() { # port [timeout_s]
+    port=$1
+    timeout=${2:-60}
+    i=0
+    while ! (exec 3<>"/dev/tcp/127.0.0.1/$port") 2>/dev/null; do
+        i=$((i + 1))
+        if [ "$i" -ge $((timeout * 5)) ]; then
+            echo "port $port never came up" >&2
+            return 1
+        fi
+        sleep 0.2
+    done
+    exec 3>&- 2>/dev/null || true
+}
+
+# start_network [base_port]: boots N_NODES servers; sets RPC_PORT_0..2
+start_network() {
+    base=${1:-$((RANDOM % 20000 + 30000))}
+    n=0
+    while [ "$n" -lt "$N_NODES" ]; do
+        server config new "127.0.0.1:$((base + n * 2))" "127.0.0.1:$((base + n * 2 + 1))" \
+            > "$WORK/node$n.toml"
+        n=$((n + 1))
+    done
+    i=0
+    while [ "$i" -lt "$N_NODES" ]; do
+        j=0
+        while [ "$j" -lt "$N_NODES" ]; do
+            if [ "$i" != "$j" ]; then
+                server config get-node < "$WORK/node$j.toml" >> "$WORK/node$i.toml"
+            fi
+            j=$((j + 1))
+        done
+        i=$((i + 1))
+    done
+    n=0
+    while [ "$n" -lt "$N_NODES" ]; do
+        server run < "$WORK/node$n.toml" &
+        PIDS="$PIDS $!"
+        eval "RPC_PORT_$n=$((base + n * 2 + 1))"
+        n=$((n + 1))
+    done
+    n=0
+    while [ "$n" -lt "$N_NODES" ]; do
+        wait_for_port_connect $((base + n * 2 + 1))
+        n=$((n + 1))
+    done
+}
+
+new_client() { # rpc_port -> writes $WORK/client_$port.toml, echoes path
+    port=$1
+    cfg="$WORK/client_$port.toml"
+    client config new "http://127.0.0.1:$port" > "$cfg"
+    echo "$cfg"
+}
+
+wait_for_sequence() { # client_cfg expected_seq [timeout_s]
+    cfg=$1
+    want=$2
+    timeout=${3:-30}
+    i=0
+    while true; do
+        seq=$(client get-last-sequence < "$cfg" 2>/dev/null || echo "")
+        [ "$seq" = "$want" ] && return 0
+        i=$((i + 1))
+        if [ "$i" -ge $((timeout * 10)) ]; then
+            echo "sequence never reached $want (last: '$seq')" >&2
+            return 1
+        fi
+        sleep 0.1
+    done
+}

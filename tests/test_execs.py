@@ -1,0 +1,48 @@
+"""Shell e2e tier: run every executable script in tests/shell/ with the
+framework's CLI shims on PATH — the reference's tests/execs.rs harness
+(`/root/reference/tests/execs.rs:11-60`) rebuilt for this package.
+
+Scripts use bash (for $RANDOM and /dev/tcp) + the lib.sh helpers and
+drive real server/client processes over localhost; a script passes iff
+it exits 0.
+"""
+
+import os
+import stat
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SHELL_DIR = os.path.join(HERE, "shell")
+
+
+def _scripts():
+    out = []
+    for name in sorted(os.listdir(SHELL_DIR)):
+        path = os.path.join(SHELL_DIR, name)
+        if os.path.isfile(path) and os.stat(path).st_mode & stat.S_IXUSR:
+            out.append(name)
+    return out
+
+
+@pytest.mark.parametrize("script", _scripts())
+def test_shell_script(script):
+    env = dict(
+        os.environ,
+        PATH=os.path.join(REPO, "bin") + os.pathsep + os.environ["PATH"],
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        ["/bin/bash", os.path.join(SHELL_DIR, script)],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
