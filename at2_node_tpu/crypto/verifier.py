@@ -30,15 +30,6 @@ import numpy as np
 from .keys import verify_one
 
 
-def _default_buckets() -> tuple:
-    from ..ops.ed25519 import BUCKETS
-
-    return BUCKETS
-
-
-DEFAULT_BUCKETS = None  # resolved lazily to ops.ed25519.BUCKETS
-
-
 class Verifier(Protocol):
     """Anything that can check ed25519 signatures asynchronously."""
 
@@ -48,6 +39,9 @@ class Verifier(Protocol):
     async def verify_many(
         self, items: Sequence[Tuple[bytes, bytes, bytes]]
     ) -> List[bool]:
+        ...
+
+    async def warmup(self) -> None:
         ...
 
     async def close(self) -> None:
@@ -61,6 +55,9 @@ class CpuVerifier:
 
     def __init__(self, max_workers: int | None = None) -> None:
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
+
+    async def warmup(self) -> None:
+        pass  # nothing to compile
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         loop = asyncio.get_running_loop()
@@ -108,7 +105,10 @@ class TpuBatchVerifier:
         self.batch_size = batch_size
         self.max_delay = max_delay
         if buckets is None:
-            buckets = _default_buckets()
+            # One bucket == one compiled program: a flush never exceeds
+            # batch_size, so padding to it keeps every dispatch the same
+            # shape and warmup() covers all compilation up front.
+            buckets = ()
         self.buckets = tuple(sorted(set(buckets) | {batch_size}))
         self._queue: List[_Pending] = []
         self._wakeup = asyncio.Event()
@@ -182,6 +182,21 @@ class TpuBatchVerifier:
         from ..ops import ed25519 as kernel
 
         return kernel.verify_batch(pks, msgs, sigs, batch_size=bucket)
+
+    async def warmup(self) -> None:
+        """Compile the smallest bucket's program before serving traffic.
+
+        XLA/Mosaic compilation takes tens of seconds cold; a node must not
+        report ready (bind its RPC port) while the first real signature
+        would stall behind the compiler. Dispatches one throwaway batch
+        through the production path and waits for it."""
+        from .keys import SignKeyPair
+
+        kp = SignKeyPair.from_hex("01" * 32)
+        msg = b"verifier warmup"
+        ok = await self.verify(kp.public, msg, kp.sign(msg))
+        if not ok:
+            raise RuntimeError("verifier warm-up batch failed to verify")
 
     async def _dispatch(self, batch: List[_Pending]) -> None:
         bucket = self._bucket_for(len(batch))

@@ -20,6 +20,13 @@ connections, each authenticated by the X25519 handshake
 Delivery is best-effort (murmur semantics, `/root/reference/technical.md:9-10`):
 sends while a peer is down are buffered in a bounded queue and dropped
 oldest-first on overflow.
+
+Messages are coalesced: a wire frame is the plain concatenation of queued
+messages (broadcast records are self-delimiting — see
+`broadcast.messages.parse_frame`), so under load one AEAD seal and one
+syscall carry up to MAX_BATCH_MSGS protocol messages — the amortization
+that lets the broadcast plane keep pace with the TPU verifier's batch
+throughput.
 """
 
 from __future__ import annotations
@@ -27,7 +34,7 @@ from __future__ import annotations
 import asyncio
 import logging
 from dataclasses import dataclass
-from typing import Awaitable, Callable, Dict, Iterable, Optional
+from typing import Awaitable, Callable, Dict, Iterable, List, Optional
 
 from ..crypto.keys import ExchangeKeyPair
 from . import transport
@@ -35,6 +42,15 @@ from . import transport
 logger = logging.getLogger(__name__)
 
 SEND_QUEUE_CAP = 4096
+# Coalescing bounds: one wire frame carries up to MAX_BATCH_MSGS queued
+# messages (one AEAD + one syscall for all of them). Broadcast messages
+# are self-delimiting fixed-size records (broadcast.messages.parse_frame),
+# so coalescing is plain concatenation — no extra framing layer. Batches
+# form naturally under load: while a frame drains, the queue refills, so
+# the next frame is bigger — idle traffic still goes out one message at a
+# time with no added latency.
+MAX_BATCH_MSGS = 1024
+MAX_BATCH_BYTES = 4 * 1024 * 1024
 
 
 @dataclass(frozen=True)
@@ -125,7 +141,7 @@ class Mesh:
     async def _outbound_loop(self, peer: Peer, q: asyncio.Queue) -> None:
         backoff = 0.1
         host, port = peer.host_port()
-        pending: Optional[bytes] = None
+        pending: Optional[List[bytes]] = None  # batch to resend after redial
         while not self._closed:
             try:
                 channel = await transport.connect(host, port, self.keypair)
@@ -147,9 +163,23 @@ class Mesh:
             self._channels.add(channel)
             try:
                 while True:
-                    frame = pending if pending is not None else await q.get()
-                    pending = frame
-                    await channel.send(frame)
+                    if pending is None:
+                        batch = [await q.get()]
+                        size = len(batch[0])
+                        # drain whatever accumulated while the last frame
+                        # was in flight (bounded)
+                        while (
+                            len(batch) < MAX_BATCH_MSGS
+                            and size < MAX_BATCH_BYTES
+                        ):
+                            try:
+                                m = q.get_nowait()
+                            except asyncio.QueueEmpty:
+                                break
+                            batch.append(m)
+                            size += len(m)
+                        pending = batch
+                    await channel.send(b"".join(pending))
                     pending = None
             except (transport.ChannelClosed, ConnectionError):
                 logger.warning("connection to %s dropped; redialing", peer.address)

@@ -70,6 +70,15 @@ class Service(At2Servicer):
     async def start(config: Config) -> "Service":
         service = Service(config)
         service.verifier = config.verifier.make()
+        # Compile the device verifier BEFORE binding the RPC port: a node
+        # is not ready while its first signature check would stall tens of
+        # seconds behind XLA compilation (readiness probes poll the port —
+        # tests/shell/lib.sh, /root/reference/tests/cli.rs:119-131).
+        try:
+            await service.verifier.warmup()
+        except Exception:
+            await service.verifier.close()
+            raise
         service.mesh = Mesh(
             config.node_address,
             config.network_key,

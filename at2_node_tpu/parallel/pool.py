@@ -31,8 +31,7 @@ from ..ops import ed25519 as kernel
 BATCH_AXIS = "batch"
 
 # jit caches keyed by mesh (Mesh is hashable); one compiled program per
-# (mesh, bucket) pair, mirroring the fixed-bucket policy of the single-chip
-# path (ops.ed25519.BUCKETS).
+# (mesh, batch shape) pair.
 _SHARDED_VERIFY: dict = {}
 _SHARDED_PALLAS: dict = {}
 _SHARDED_COUNT: dict = {}
@@ -199,12 +198,8 @@ class PoolVerifier(TpuBatchVerifier):
         # on hardware: round both up to quantum multiples.
         q = _pool_quantum(n_dev)
         batch_size = ((batch_size + q - 1) // q) * q
-        buckets = tuple(
-            sorted({pool_bucket_for(b, n_dev, q) for b in kernel.BUCKETS})
-        )
-        super().__init__(
-            batch_size=batch_size, max_delay=max_delay, buckets=buckets
-        )
+        # single bucket == single compiled program (see TpuBatchVerifier)
+        super().__init__(batch_size=batch_size, max_delay=max_delay)
 
     def _run_batch(self, pks, msgs, sigs, bucket):
         return verify_batch_sharded(
