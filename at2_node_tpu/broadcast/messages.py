@@ -29,12 +29,15 @@ from ..types import ThinTransaction
 GOSSIP = 1
 ECHO = 2
 READY = 3
+REQUEST = 4
 
 _PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
 _ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
+_REQUEST = struct.Struct("<32sI32s")  # sender, seq, hash
 
 PAYLOAD_WIRE = 1 + _PAYLOAD.size
 ATTEST_WIRE = 1 + _ATTEST.size
+REQUEST_WIRE = 1 + _REQUEST.size
 
 _ECHO_TAG = b"at2-node-tpu/echo/v1"
 _READY_TAG = b"at2-node-tpu/ready/v1"
@@ -119,6 +122,30 @@ class Attestation:
         return Attestation(phase, origin, sender, seq, chash, sig)
 
 
+@dataclass(frozen=True)
+class ContentRequest:
+    """Pull request for a payload whose Ready quorum was observed but whose
+    gossip never arrived (contagion totality catch-up — the reference left
+    this as the open "catchup mechanism" roadmap item,
+    `/root/reference/README.md:53`). Carries no signature: requests are
+    only ever accepted over the mesh's authenticated channels, so the
+    transport identifies the requester."""
+
+    sender: bytes
+    sequence: int
+    content_hash: bytes
+
+    def encode(self) -> bytes:
+        return bytes([REQUEST]) + _REQUEST.pack(
+            self.sender, self.sequence, self.content_hash
+        )
+
+    @staticmethod
+    def decode_body(body: bytes) -> "ContentRequest":
+        sender, seq, chash = _REQUEST.unpack(body)
+        return ContentRequest(sender, seq, chash)
+
+
 def parse_frame(frame: bytes) -> list:
     """Split a frame into messages (frames may coalesce many)."""
     out = []
@@ -135,6 +162,11 @@ def parse_frame(frame: bytes) -> list:
                 raise WireError("truncated attestation")
             out.append(Attestation.decode_body(kind, bytes(view[1:ATTEST_WIRE])))
             view = view[ATTEST_WIRE:]
+        elif kind == REQUEST:
+            if len(view) < REQUEST_WIRE:
+                raise WireError("truncated content request")
+            out.append(ContentRequest.decode_body(bytes(view[1:REQUEST_WIRE])))
+            view = view[REQUEST_WIRE:]
         else:
             raise WireError(f"unknown message kind {kind}")
     return out

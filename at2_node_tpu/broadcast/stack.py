@@ -19,18 +19,31 @@ from its murmur / sieve / contagion crates
   Ready quorum without having sieve-delivered joins the quorum
   (amplification) so delivery is total across correct nodes.
 
+Totality assumption: final delivery additionally requires the payload
+content itself, which arrives only via gossip — a node that collects a
+full Ready quorum but never received the payload pulls it from the Ready
+quorum's members (content re-request, see ``_request_content``). The
+re-request rides the same best-effort plane as gossip; under permanent
+message loss to a node, that node may still not deliver — matching the
+reference's open "catchup mechanism" roadmap item
+(`/root/reference/README.md:53`).
+
 Thresholds count PEERS (self excluded — the reference's config lists the
 N−1 other nodes, `/root/reference/tests/cli.rs:173-184`, and sets every
 threshold to that count, so an empty peer list degenerates to immediate
 self-delivery, matching the reference's standalone-node test
 `/root/reference/tests/server-config-resolve-addrs`).
 
-Verification is the hot path (BASELINE north star): inbound messages are
-deduplicated BEFORE verification, then fanned out to a pool of worker
-tasks whose concurrent `verifier.verify` calls are what fills the TPU
-batch accumulator (`crypto.verifier.TpuBatchVerifier`). State mutations
-happen synchronously after the verify await on the single event loop — the
-same single-writer argument as the reference's actors (SURVEY.md §5).
+Verification is the hot path (BASELINE north star): each worker drains a
+CHUNK of the inbox per iteration and runs a three-stage pipeline —
+(1) synchronous pre-checks (dedup, slot caps, per-origin single-vote) that
+also insert into the dedup sets so no other worker double-verifies;
+(2) ONE ``verifier.verify_many`` call for every signature the chunk needs
+(this is what fills the TPU batch accumulator in bulk — one asyncio
+future per chunk instead of per message); (3) synchronous state
+transitions, re-validated against races with other workers that awaited
+concurrently. State mutations stay on the single event loop — the same
+single-writer argument as the reference's actors (SURVEY.md §5).
 """
 
 from __future__ import annotations
@@ -44,7 +57,16 @@ from typing import Dict, Optional, Set, Tuple
 from ..crypto.keys import SignKeyPair
 from ..crypto.verifier import Verifier
 from ..net.peers import Mesh, Peer
-from .messages import ECHO, READY, Attestation, Payload, WireError, parse_frame
+from .messages import (
+    ECHO,
+    GOSSIP,
+    READY,
+    Attestation,
+    ContentRequest,
+    Payload,
+    WireError,
+    parse_frame,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +90,12 @@ MAX_LIVE_SLOTS = 1 << 17
 DELIVERED_RETENTION = 120.0  # s after delivery before the slot compacts
 SLOT_MAX_AGE = 3600.0  # s an undelivered slot may linger
 GC_INTERVAL = 30.0
+# Min seconds between content re-requests for a ready-quorate slot whose
+# payload gossip never arrived (pull-based catch-up; see module docstring).
+REQUEST_RETRY = 5.0
+# Max messages one worker drains from the inbox per iteration: the unit of
+# bulk verification (one verify_many call -> one slice of the TPU batch).
+WORKER_CHUNK = 256
 
 
 class _BoundedSet:
@@ -105,10 +133,12 @@ class _SlotState:
         "sieve_delivered",
         "delivered",
         "created",
+        "content_requested_at",
     )
 
     def __init__(self) -> None:
         self.created = time.monotonic()
+        self.content_requested_at = 0.0  # last pull request, 0 = never
         self.contents: Dict[bytes, Payload] = {}  # content_hash -> payload
         self.echoed_hash: Optional[bytes] = None  # sieve: first content only
         self.echoes: Dict[bytes, Set[bytes]] = defaultdict(set)  # hash -> origins
@@ -134,7 +164,7 @@ class Broadcast:
         verifier: Verifier,
         echo_threshold: Optional[int] = None,
         ready_threshold: Optional[int] = None,
-        workers: int = 64,
+        workers: int = 16,
     ) -> None:
         self.keypair = keypair
         self.mesh = mesh
@@ -166,6 +196,9 @@ class Broadcast:
             "invalid_sig": 0,
             "delivered": 0,
             "slots_dropped": 0,
+            "content_req_tx": 0,
+            "content_req_rx": 0,
+            "content_served": 0,
         }
 
     async def start(self) -> None:
@@ -176,6 +209,8 @@ class Broadcast:
     async def close(self) -> None:
         for t in self._tasks:
             t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
 
     # -- inbound ----------------------------------------------------------
 
@@ -189,14 +224,14 @@ class Broadcast:
             return
         for msg in msgs:
             try:
-                self._inbox.put_nowait(msg)
+                self._inbox.put_nowait((peer, msg))
             except asyncio.QueueFull:
                 logger.warning("inbox overflow; dropping message")
 
     async def broadcast(self, payload: Payload) -> None:
         """Local submission (the gRPC SendAsset handler calls this —
         reference: `handle.broadcast`, rpc.rs:275-284)."""
-        await self._inbox.put(payload)
+        await self._inbox.put((None, payload))
 
     # -- workers ----------------------------------------------------------
 
@@ -215,49 +250,174 @@ class Broadcast:
                     if not state.delivered:
                         self._undelivered -= 1
                     del self._slots[slot]
+                elif not state.delivered:
+                    # periodic retry of the content pull for quorate slots
+                    # still missing their payload (lost request/response)
+                    for chash, origins in state.readies.items():
+                        if (
+                            len(origins) >= self.ready_threshold
+                            and chash not in state.contents
+                        ):
+                            self._request_content(slot, state, chash)
 
     async def _worker(self) -> None:
         while True:
-            msg = await self._inbox.get()
+            item = await self._inbox.get()
+            chunk = [item]
+            while len(chunk) < WORKER_CHUNK:
+                try:
+                    chunk.append(self._inbox.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
             try:
-                if isinstance(msg, Payload):
-                    await self._on_gossip(msg)
-                else:
-                    await self._on_attestation(msg)
+                await self._process_chunk(chunk)
             except Exception:
                 logger.exception("broadcast worker error")
 
-    async def _on_gossip(self, payload: Payload) -> None:
+    async def _process_chunk(self, chunk) -> None:
+        """Three stages (module docstring): sync pre-checks -> one bulk
+        verify -> sync state transitions (re-validated against races)."""
+        to_verify = []
+        actions = []
+        for peer, msg in chunk:
+            if isinstance(msg, Payload):
+                if self._pre_gossip(msg):
+                    to_verify.append(
+                        (
+                            msg.sender,
+                            msg.transaction.signing_bytes(),
+                            msg.signature,
+                        )
+                    )
+                    actions.append((GOSSIP, msg))
+            elif isinstance(msg, ContentRequest):
+                self._on_request(peer, msg)
+            else:
+                if self._pre_attestation(msg):
+                    to_verify.append((msg.origin, msg.to_sign(), msg.signature))
+                    actions.append((msg.phase, msg))
+        if not to_verify:
+            return
+        results = await self.verifier.verify_many(to_verify)
+        for (kind, msg), ok in zip(actions, results):
+            if not ok:
+                self.stats["invalid_sig"] += 1
+                if kind == GOSSIP:
+                    logger.warning(
+                        "invalid payload signature for slot (%s, %d)",
+                        msg.sender.hex()[:16],
+                        msg.sequence,
+                    )
+                else:
+                    logger.warning(
+                        "invalid %s signature from %s",
+                        "echo" if kind == ECHO else "ready",
+                        msg.origin.hex()[:16],
+                    )
+                continue
+            if kind == GOSSIP:
+                self._post_gossip(msg)
+            else:
+                self._post_attestation(msg)
+
+    # -- stage 1: synchronous pre-checks (dedup inserts happen here, so no
+    # other worker can double-verify the same message) --------------------
+
+    def _pre_gossip(self, payload: Payload) -> bool:
         self.stats["gossip_rx"] += 1
         slot = payload.slot
         if slot in self._delivered_slots:
-            return  # already committed and compacted
+            return False  # already committed and compacted
+        # Slot-cap check BEFORE the dedup insert and the verify stage: a
+        # valid message dropped at the cap must stay retryable (its
+        # deterministic retransmission would otherwise be dedup-suppressed
+        # forever), and a message that will be dropped must not spend
+        # verifier throughput. Concurrent workers may overshoot the cap by
+        # at most the worker pool's chunk capacity — negligible vs the cap.
+        if slot not in self._slots and self._undelivered >= MAX_LIVE_SLOTS:
+            self.stats["slots_dropped"] += 1
+            return False
         chash = payload.content_hash()
         key = (slot, chash)
         if key in self._gossip_seen:
-            return
-        self._gossip_seen.add(key)
+            return False
         state = self._slots.get(slot)
-        if state is not None and (
-            len(state.contents) >= MAX_CONTENTS_PER_SLOT or chash in state.contents
-        ):
-            return
-        ok = await self.verifier.verify(
-            payload.sender, payload.transaction.signing_bytes(), payload.signature
+        if state is not None:
+            if chash in state.contents:
+                return False
+            # Content cap: a byzantine sender must not grow state.contents
+            # unboundedly — but a content the network has already voted
+            # toward quorum for is always admitted, or an equivocator
+            # could fill the cap with junk contents and permanently block
+            # the quorate payload (incl. the pull-based catch-up path).
+            # NOTE: cap rejections deliberately do NOT enter _gossip_seen,
+            # so a retransmission after the content becomes quorate (or
+            # after GC) is processed, not dedup-suppressed.
+            if (
+                len(state.contents) >= MAX_CONTENTS_PER_SLOT
+                and not self._content_wanted(state, chash)
+            ):
+                return False
+        self._gossip_seen.add(key)
+        return True
+
+    def _content_wanted(self, state: _SlotState, chash: bytes) -> bool:
+        """A content with quorum-level votes is stored regardless of the
+        per-slot content cap (it may be the only deliverable content)."""
+        return (
+            len(state.readies.get(chash, ())) >= max(self.ready_threshold, 1)
+            or len(state.echoes.get(chash, ())) >= max(self.echo_threshold, 1)
         )
-        if not ok:
-            self.stats["invalid_sig"] += 1
+
+    def _pre_attestation(self, att: Attestation) -> bool:
+        phase_key = "echo_rx" if att.phase == ECHO else "ready_rx"
+        self.stats[phase_key] += 1
+        if att.origin not in self.mesh.by_sign:
             logger.warning(
-                "invalid payload signature for slot (%s, %d)",
-                payload.sender.hex()[:16],
-                payload.sequence,
+                "attestation from unknown origin %s", att.origin.hex()[:16]
             )
-            return
+            return False
+        slot = (att.sender, att.sequence)
+        if slot in self._delivered_slots:
+            return False
+        # Slot-cap check before dedup/verify — same rationale as gossip:
+        # capacity drops must not poison the dedup set or burn verifier time.
         if slot not in self._slots and self._undelivered >= MAX_LIVE_SLOTS:
             self.stats["slots_dropped"] += 1
+            return False
+        # Exact-duplicate suppression keyed INCLUDING the signature, so a
+        # forged message can never shadow the origin's real (differently
+        # signed) vote; per-origin single-vote enforcement happens after
+        # verification via *_by_origin below.
+        seen_key = (att.phase, att.origin, slot, att.content_hash, att.signature)
+        if seen_key in self._attest_seen:
+            return False
+        self._attest_seen.add(seen_key)
+        state = self._slots.get(slot)
+        if state is not None:
+            by_origin = (
+                state.echo_by_origin if att.phase == ECHO else state.ready_by_origin
+            )
+            if att.origin in by_origin:
+                return False  # this origin already cast a verified vote here
+        return True
+
+    # -- stage 3: synchronous state transitions (post-verify; every check
+    # that another worker could have raced during the verify await is
+    # re-validated here) ---------------------------------------------------
+
+    def _post_gossip(self, payload: Payload) -> None:
+        slot = payload.slot
+        if slot in self._delivered_slots:
             return
+        chash = payload.content_hash()
         state = self._new_or_existing_slot(slot)
-        if chash in state.contents or len(state.contents) >= MAX_CONTENTS_PER_SLOT:
+        if chash in state.contents:
+            return
+        if (
+            len(state.contents) >= MAX_CONTENTS_PER_SLOT
+            and not self._content_wanted(state, chash)
+        ):
             return
         state.contents[chash] = payload
         # murmur: relay to everyone (gossip_size = full network)
@@ -268,47 +428,54 @@ class Broadcast:
             self._send_attestation(ECHO, payload.sender, payload.sequence, chash)
         self._advance(slot, state, chash)
 
-    async def _on_attestation(self, att: Attestation) -> None:
-        phase_key = "echo_rx" if att.phase == ECHO else "ready_rx"
-        self.stats[phase_key] += 1
-        if att.origin not in self.mesh.by_sign:
-            logger.warning("attestation from unknown origin %s", att.origin.hex()[:16])
-            return
+    def _post_attestation(self, att: Attestation) -> None:
         slot = (att.sender, att.sequence)
         if slot in self._delivered_slots:
-            return  # already committed and compacted
-        # Exact-duplicate suppression keyed INCLUDING the signature, so a
-        # forged message can never shadow the origin's real (differently
-        # signed) vote; per-origin single-vote enforcement happens after
-        # verification via *_by_origin below.
-        seen_key = (att.phase, att.origin, slot, att.content_hash, att.signature)
-        if seen_key in self._attest_seen:
-            return
-        self._attest_seen.add(seen_key)
-        state = self._slots.get(slot)
-        by_origin = None
-        if state is not None:
-            by_origin = state.echo_by_origin if att.phase == ECHO else state.ready_by_origin
-            if att.origin in by_origin:
-                return  # this origin already cast a verified vote here
-        ok = await self.verifier.verify(att.origin, att.to_sign(), att.signature)
-        if not ok:
-            self.stats["invalid_sig"] += 1
-            logger.warning("invalid %s signature from %s",
-                           "echo" if att.phase == ECHO else "ready",
-                           att.origin.hex()[:16])
-            return
-        if slot not in self._slots and self._undelivered >= MAX_LIVE_SLOTS:
-            self.stats["slots_dropped"] += 1
             return
         state = self._new_or_existing_slot(slot)
-        by_origin = state.echo_by_origin if att.phase == ECHO else state.ready_by_origin
+        by_origin = (
+            state.echo_by_origin if att.phase == ECHO else state.ready_by_origin
+        )
         if att.origin in by_origin:
             return
         by_origin[att.origin] = att.content_hash
         votes = state.echoes if att.phase == ECHO else state.readies
         votes[att.content_hash].add(att.origin)
         self._advance(slot, state, att.content_hash)
+
+    def _on_request(self, peer: Optional[Peer], req: ContentRequest) -> None:
+        """Serve a peer's content pull (no verify: channel-authenticated)."""
+        self.stats["content_req_rx"] += 1
+        if peer is None:
+            return  # requests only make sense from the wire
+        state = self._slots.get((req.sender, req.sequence))
+        if state is None:
+            return  # unknown or already compacted; best-effort
+        payload = state.contents.get(req.content_hash)
+        if payload is not None:
+            self.stats["content_served"] += 1
+            self.mesh.send(peer, payload.encode())
+
+    def _request_content(self, slot: Slot, state: _SlotState, chash: bytes) -> None:
+        """Pull a ready-quorate slot's missing payload from its Ready voters
+        (they either hold the content or know who gossiped it; falls back to
+        all peers when no voter maps to a known peer)."""
+        now = time.monotonic()
+        if now - state.content_requested_at < REQUEST_RETRY:
+            return
+        state.content_requested_at = now
+        self.stats["content_req_tx"] += 1
+        frame = ContentRequest(slot[0], slot[1], chash).encode()
+        targets = [
+            self.mesh.by_sign[origin]
+            for origin in state.readies.get(chash, ())
+            if origin in self.mesh.by_sign
+        ]
+        if targets:
+            for peer in targets:
+                self.mesh.send(peer, frame)
+        else:
+            self.mesh.broadcast(frame)
 
     def _new_or_existing_slot(self, slot: Slot) -> _SlotState:
         state = self._slots.get(slot)
@@ -350,12 +517,13 @@ class Broadcast:
             state.ready_sent = True
             self._send_attestation(READY, slot[0], slot[1], chash)
         # deliver: enough readies AND the payload content is known
-        if (
-            len(state.readies[chash]) >= self.ready_threshold
-            and state.ready_sent
-            and chash in state.contents
-        ):
-            state.delivered = True
-            self._undelivered -= 1
-            self.stats["delivered"] += 1
-            self.delivered.put_nowait(state.contents[chash])
+        if len(state.readies[chash]) >= self.ready_threshold and state.ready_sent:
+            if chash in state.contents:
+                state.delivered = True
+                self._undelivered -= 1
+                self.stats["delivered"] += 1
+                self.delivered.put_nowait(state.contents[chash])
+            else:
+                # quorum reached but the gossip never landed here: pull the
+                # payload from the voters (totality catch-up)
+                self._request_content(slot, state, chash)

@@ -15,6 +15,7 @@ from at2_node_tpu.broadcast.messages import (
     ECHO,
     READY,
     Attestation,
+    ContentRequest,
     Payload,
     WireError,
     parse_frame,
@@ -76,12 +77,22 @@ class FakeMesh:
         self.by_sign = {p.sign_public: p for p in peers}
         self.by_exchange = {p.exchange_public: p for p in peers}
         self.sent = []
+        self.unicast = []  # (peer, frame) pairs from Mesh.send
 
     def broadcast(self, frame, exclude=()):
         self.sent.append(frame)
 
+    def send(self, peer, frame):
+        self.unicast.append((peer, frame))
+
     def sent_messages(self):
         return [m for f in self.sent for m in parse_frame(f)]
+
+
+async def inject(bcast, msg, peer=None):
+    """Feed one message into the broadcast inbox as the workers expect it
+    ((peer, msg); peer=None models local submission)."""
+    await bcast._inbox.put((peer, msg))
 
 
 def make_net(n_peers):
@@ -144,9 +155,9 @@ class TestStateMachine:
         payload = make_payload(sender)
         await bcast.broadcast(payload)
         for kp in peer_keys:
-            await bcast._inbox.put(echo_from(kp, payload, ECHO))
+            await inject(bcast, echo_from(kp, payload, ECHO))
         for kp in peer_keys:
-            await bcast._inbox.put(echo_from(kp, payload, READY))
+            await inject(bcast, echo_from(kp, payload, READY))
         delivered = await asyncio.wait_for(bcast.delivered.get(), 2)
         assert delivered == payload
         # the node itself gossiped, echoed, and readied
@@ -164,7 +175,7 @@ class TestStateMachine:
         payload = make_payload(sender)
         await bcast.broadcast(payload)
         for kp in peer_keys[:2]:  # 2 of 3 echoes: below threshold
-            await bcast._inbox.put(echo_from(kp, payload, ECHO))
+            await inject(bcast, echo_from(kp, payload, ECHO))
         await settle(bcast)
         assert bcast.delivered.empty()
         await bcast.close()
@@ -190,8 +201,8 @@ class TestStateMachine:
         payload = make_payload(sender)
         await bcast.broadcast(payload)
         outsider = SignKeyPair.random()  # not in the peer set
-        await bcast._inbox.put(echo_from(outsider, payload, ECHO))
-        await bcast._inbox.put(echo_from(outsider, payload, READY))
+        await inject(bcast, echo_from(outsider, payload, ECHO))
+        await inject(bcast, echo_from(outsider, payload, READY))
         await settle(bcast)
         assert bcast.delivered.empty()
         await bcast.close()
@@ -205,7 +216,7 @@ class TestStateMachine:
         await bcast.broadcast(payload)
         # one peer echoes three times; the other stays silent
         for _ in range(3):
-            await bcast._inbox.put(echo_from(peer_keys[0], payload, ECHO))
+            await inject(bcast, echo_from(peer_keys[0], payload, ECHO))
         await settle(bcast)
         assert bcast.delivered.empty()  # 1 distinct echo < threshold 2
         await bcast.close()
@@ -230,9 +241,9 @@ class TestStateMachine:
         assert len(echoes) == 1
         # full quorum on content A only
         for kp in peer_keys:
-            await bcast._inbox.put(echo_from(kp, pay_a, ECHO))
+            await inject(bcast, echo_from(kp, pay_a, ECHO))
         for kp in peer_keys:
-            await bcast._inbox.put(echo_from(kp, pay_a, READY))
+            await inject(bcast, echo_from(kp, pay_a, READY))
         delivered = await asyncio.wait_for(bcast.delivered.get(), 2)
         assert delivered == pay_a
         await settle(bcast)
@@ -249,7 +260,7 @@ class TestStateMachine:
         payload = make_payload(sender)
         await bcast.broadcast(payload)  # payload known, but no echoes arrive
         for kp in peer_keys:
-            await bcast._inbox.put(echo_from(kp, payload, READY))
+            await inject(bcast, echo_from(kp, payload, READY))
         delivered = await asyncio.wait_for(bcast.delivered.get(), 2)
         assert delivered == payload
         # and the node joined the Ready quorum itself (amplification)
@@ -274,10 +285,89 @@ class TestStateMachine:
             payload.content_hash(),
             b"\x02" * 64,
         )
-        await bcast._inbox.put(forged)
+        await inject(bcast, forged)
         await settle(bcast)
-        await bcast._inbox.put(echo_from(peer_keys[0], payload, ECHO))
-        await bcast._inbox.put(echo_from(peer_keys[0], payload, READY))
+        await inject(bcast, echo_from(peer_keys[0], payload, ECHO))
+        await inject(bcast, echo_from(peer_keys[0], payload, READY))
         delivered = await asyncio.wait_for(bcast.delivered.get(), 2)
         assert delivered == payload
+        await bcast.close()
+
+    @pytest.mark.asyncio
+    async def test_missing_content_pulled_on_ready_quorum(self):
+        # totality catch-up: the node sees a full Ready quorum but the
+        # payload gossip never arrived — it must pull the content from the
+        # Ready voters and deliver once a voter serves it
+        bcast, mesh, peer_keys = make_net(2)
+        await start(bcast)
+        sender = SignKeyPair.random()
+        payload = make_payload(sender)
+        for kp in peer_keys:  # quorum with NO payload
+            await inject(bcast, echo_from(kp, payload, READY))
+        await settle(bcast)
+        assert bcast.delivered.empty()
+        requests = [
+            m
+            for _, f in mesh.unicast
+            for m in parse_frame(f)
+            if isinstance(m, ContentRequest)
+        ]
+        assert requests, "node never requested the missing content"
+        req = requests[0]
+        assert req.sender == payload.sender
+        assert req.content_hash == payload.content_hash()
+        # a voter serves the payload over its authenticated channel
+        await inject(bcast, payload, peer=mesh.peers[0])
+        delivered = await asyncio.wait_for(bcast.delivered.get(), 2)
+        assert delivered == payload
+        await bcast.close()
+
+    @pytest.mark.asyncio
+    async def test_quorate_content_admitted_past_content_cap(self):
+        # a byzantine equivocator fills the per-slot content cap with junk;
+        # the content the honest quorum actually voted for must still be
+        # admitted when it arrives (pull response or retransmission) —
+        # otherwise the slot can never deliver (round-2 review finding)
+        from at2_node_tpu.broadcast.stack import MAX_CONTENTS_PER_SLOT
+
+        bcast, mesh, peer_keys = make_net(2)
+        await start(bcast)
+        sender = SignKeyPair.random()
+        for i in range(MAX_CONTENTS_PER_SLOT):
+            await inject(bcast, make_payload(sender, amount=100 + i))
+        await settle(bcast)
+        target = make_payload(sender, amount=999)  # not stored: cap is full
+        for kp in peer_keys:
+            await inject(bcast, echo_from(kp, target, READY))
+        await settle(bcast)
+        assert bcast.delivered.empty()
+        await inject(bcast, target, peer=mesh.peers[0])
+        delivered = await asyncio.wait_for(bcast.delivered.get(), 2)
+        assert delivered == target
+        await bcast.close()
+
+    @pytest.mark.asyncio
+    async def test_content_request_served_from_held_content(self):
+        # the serving side: a node that HAS the payload answers a peer's
+        # ContentRequest with a unicast copy
+        bcast, mesh, peer_keys = make_net(2)
+        await start(bcast)
+        sender = SignKeyPair.random()
+        payload = make_payload(sender)
+        await bcast.broadcast(payload)
+        await settle(bcast)
+        req = ContentRequest(
+            payload.sender, payload.sequence, payload.content_hash()
+        )
+        await inject(bcast, req, peer=mesh.peers[1])
+        await settle(bcast)
+        served = [
+            (p, m)
+            for p, f in mesh.unicast
+            for m in parse_frame(f)
+            if isinstance(m, Payload)
+        ]
+        assert served and served[0][0] == mesh.peers[1]
+        assert served[0][1] == payload
+        assert bcast.stats["content_served"] == 1
         await bcast.close()
