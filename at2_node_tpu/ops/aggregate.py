@@ -9,11 +9,25 @@ standard random-linear-combination batch check:
     [sum_i z_i S_i mod L] B  ==  sum_i [z_i] R_i  +  sum_i [z_i h_i] A_i
 
 with fresh random 128-bit z_i per call. If every signature is valid the
-equation always holds; if any is invalid it holds with probability
-<= 2^-128 over the z_i. A False result says "some signature is bad", so
+equation always holds. A False result says "some signature is bad", so
 callers fall back to individual verification to find culprits (the
 reference has no aggregate path at all — every Echo/Ready is checked
 one-by-one [dep-inferred from /root/reference/technical.md:11-15]).
+
+Soundness (and agreement with the cofactorless per-signature paths): the
+plain RLC argument only bounds cheating probability when every residual
+e_i = [S_i]B - R_i - [h_i]A_i lies in the prime-order subgroup — a
+byzantine signer who plants 8-torsion components in R_i/A_i gets
+small-order e_i that can cancel across lanes with probability ~1/4,
+making the naive batch check accept certificates every per-signature
+cofactorless verifier (OpenSSL, the XLA graph, the Pallas kernel)
+rejects. This implementation therefore batch-checks that every R_i and
+A_i is torsion-free ([L]P == identity, one extra fixed-window Straus pass
+over the lanes) BEFORE trusting the RLC equation. Torsion-free inputs
+make e_i prime-order, so (a) a bad certificate passes with probability
+<= 2^-127 over the z_i, and (b) cofactored and cofactorless verdicts
+coincide — the aggregate path can never diverge from per-signature
+verification on an accepted certificate.
 
 TPU mapping: per-lane Straus computes T_i = [z_i]R_i + [z_i h_i]A_i for
 all lanes at once (both points variable — generalizes
@@ -63,6 +77,36 @@ def double_scalar_mul(p_point, p_windows, q_point, q_windows):
     return jax.lax.fori_loop(0, base.N_WINDOWS, body, acc0)
 
 
+# Group order L as static 4-bit Straus windows (msb-first): the torsion
+# check multiplies by a COMPILE-TIME scalar, so the window indices are
+# constants, not per-lane data.
+_L_WINDOWS = _windows_from_int(base.L)
+
+
+def mul_by_L(points: jnp.ndarray) -> jnp.ndarray:
+    """[L]P for a (..., 4, 20) stack of points (fixed-scalar Straus)."""
+    table = ed.build_table(points)
+    batch_shape = points.shape[:-2]
+    acc0 = jnp.broadcast_to(jnp.asarray(ed.IDENTITY), batch_shape + (4, fe.N_LIMBS))
+    windows = jnp.asarray(_L_WINDOWS)
+
+    def body(w, acc):
+        acc = ed.double(ed.double(ed.double(ed.double(acc))))
+        idx = jnp.broadcast_to(windows[w], batch_shape)
+        return ed.add(acc, ed._lookup(table, idx))
+
+    return jax.lax.fori_loop(0, base.N_WINDOWS, body, acc0)
+
+
+def is_identity(p: jnp.ndarray) -> jnp.ndarray:
+    """Projective check P == (0 : 1 : 1): x == 0 AND y == z (the x==0
+    2-torsion point (0, -1) fails the second clause)."""
+    return fe.is_zero(p[..., X_IDX, :]) & fe.eq(p[..., Y_IDX, :], p[..., Z_IDX, :])
+
+
+X_IDX, Y_IDX, Z_IDX = ed.X, ed.Y, ed.Z
+
+
 def tree_reduce_points(pts: jnp.ndarray) -> jnp.ndarray:
     """Sum a (B, 4, 20) stack of points into one point with log2(B)
     halving rounds of batched additions (B must be a power of two)."""
@@ -78,6 +122,17 @@ def _aggregate_graph(r_bytes, a_bytes, z_win, zh_win, zs_win, valid):
     """Jittable check of the RLC equation; returns scalar bool."""
     a_point, a_ok = ed.decompress(a_bytes)
     r_point, r_ok = ed.decompress(r_bytes)
+    # Small-order defense (see module docstring): every R and A must be in
+    # the prime-order subgroup or the RLC equation is not sound. Invalid
+    # lanes are forced to the (prime-order) base point by decompress, so
+    # padding passes trivially.
+    torsion_free = is_identity(
+        mul_by_L(jnp.concatenate([r_point, a_point], axis=0))
+    )
+    n_lanes = r_bytes.shape[0]
+    subgroup_ok = jnp.all(torsion_free[:n_lanes] | ~valid) & jnp.all(
+        torsion_free[n_lanes:] | ~valid
+    )
     t = double_scalar_mul(r_point, z_win, a_point, zh_win)
     # invalid lanes (padding) contribute the identity
     ident = jnp.asarray(ed.IDENTITY)
@@ -92,7 +147,7 @@ def _aggregate_graph(r_bytes, a_bytes, z_win, zh_win, zs_win, valid):
     eq = fe.eq(
         fe.mul(lhs[ed.X], q[ed.Z]), fe.mul(q[ed.X], lhs[ed.Z])
     ) & fe.eq(fe.mul(lhs[ed.Y], q[ed.Z]), fe.mul(q[ed.Y], lhs[ed.Z]))
-    return eq & jnp.all(a_ok | ~valid) & jnp.all(r_ok | ~valid)
+    return eq & subgroup_ok & jnp.all(a_ok | ~valid) & jnp.all(r_ok | ~valid)
 
 
 _aggregate_jit = jax.jit(_aggregate_graph)

@@ -5,10 +5,21 @@ the n=64 BASELINE shape runs on real hardware (validated there, same
 graph modulo batch size).
 """
 
+import hashlib
+
 import numpy as np
+import pytest
 
 from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.ops import ed25519 as base
+from at2_node_tpu.ops import edwards as ed
+from at2_node_tpu.ops import field as fe
 from at2_node_tpu.ops.aggregate import aggregate_verify, verify_certificate
+
+# The two-table Straus + torsion-check graph is a minutes-scale XLA compile
+# on CPU (cached across runs via the persistent compilation cache, but the
+# cold path belongs in the kernel tier, not the fast dev loop).
+pytestmark = pytest.mark.slow
 
 N = 4
 
@@ -18,6 +29,37 @@ def _cert(n=N):
     msgs = [b"attestation %d" % i for i in range(n)]
     sigs = [k.sign(m) for k, m in zip(keys, msgs)]
     return [k.public for k in keys], msgs, sigs
+
+
+def _affine_scalar_mult(k: int, p: tuple) -> tuple:
+    acc = (0, 1)
+    while k:
+        if k & 1:
+            acc = ed.affine_add_ints(acc, p)
+        p = ed.affine_add_ints(p, p)
+        k >>= 1
+    return acc
+
+
+def _compress(pt: tuple) -> bytes:
+    x, y = pt
+    enc = bytearray(y.to_bytes(32, "little"))
+    if x & 1:
+        enc[31] |= 0x80
+    return bytes(enc)
+
+
+def _torsion_point() -> tuple:
+    """A nonzero small-order point: [L]Q for an arbitrary curve point Q."""
+    for y in range(2, 60):
+        try:
+            x = ed._recover_x(y, 0)
+        except ValueError:
+            continue
+        t = _affine_scalar_mult(base.L, (x, y))
+        if t != (0, 1):
+            return t
+    raise AssertionError("no torsion point found")
 
 
 def test_aggregate_accepts_valid_and_rejects_tampered():
@@ -44,3 +86,51 @@ def test_verify_certificate_culprit_fallback():
 
 def test_aggregate_empty():
     assert aggregate_verify([], [], []) is True
+
+
+def test_small_order_rlc_cancellation_rejected():
+    """A byzantine signer who knows its private scalar can plant an
+    8-torsion component in R: the residual e = [S]B - R - [h]A is then the
+    small-order point -T, and adversarial coefficients with z1 + z2 == 0
+    (mod 8) cancel the naive RLC sum even though every per-signature
+    cofactorless verifier rejects these signatures. The subgroup
+    (torsion-free) check must reject the certificate (ADVICE round-1
+    medium finding)."""
+    torsion = _torsion_point()
+    base_pt = (ed.BX_INT, ed.BY_INT)
+    a_scalar = 987654321987654321987654321 % base.L
+    a_pub = _compress(_affine_scalar_mult(a_scalar, base_pt))
+
+    pks, msgs, sigs = [], [], []
+    for i, r_nonce in enumerate((11111, 22222)):
+        msg = b"small-order attack %d" % i
+        r_pt = ed.affine_add_ints(_affine_scalar_mult(r_nonce, base_pt), torsion)
+        r_bytes = _compress(r_pt)
+        h = (
+            int.from_bytes(
+                hashlib.sha512(r_bytes + a_pub + msg).digest(), "little"
+            )
+            % base.L
+        )
+        s = (r_nonce + h * a_scalar) % base.L
+        pks.append(a_pub)
+        msgs.append(msg)
+        sigs.append(r_bytes + s.to_bytes(32, "little"))
+    # two honest filler lanes keep the batch at the shared compiled shape
+    filler_keys = [SignKeyPair.random() for _ in range(2)]
+    for i, k in enumerate(filler_keys):
+        msg = b"honest filler %d" % i
+        pks.append(k.public)
+        msgs.append(msg)
+        sigs.append(k.sign(msg))
+
+    # every per-signature cofactorless path rejects the attack signatures
+    assert base.verify_batch(pks, msgs, sigs).tolist() == [
+        False,
+        False,
+        True,
+        True,
+    ]
+    # z1=1, z2=7: torsion residues cancel ([8]T = identity) so the naive
+    # RLC equation HOLDS — only the subgroup check stands in the way
+    assert aggregate_verify(pks, msgs, sigs, _z_override=[1, 7, 3, 5]) is False
