@@ -47,6 +47,9 @@ class Verifier(Protocol):
     async def close(self) -> None:
         ...
 
+    def stats(self) -> dict:
+        ...
+
 
 class CpuVerifier:
     """Per-signature CPU verification on a thread pool (the reference's
@@ -55,12 +58,17 @@ class CpuVerifier:
 
     def __init__(self, max_workers: int | None = None) -> None:
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self.signatures_verified = 0
+
+    def stats(self) -> dict:
+        return {"signatures": self.signatures_verified}
 
     async def warmup(self) -> None:
         pass  # nothing to compile
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         loop = asyncio.get_running_loop()
+        self.signatures_verified += 1
         return await loop.run_in_executor(
             self._pool, verify_one, public_key, message, signature
         )
@@ -69,6 +77,7 @@ class CpuVerifier:
         self, items: Sequence[Tuple[bytes, bytes, bytes]]
     ) -> List[bool]:
         loop = asyncio.get_running_loop()
+        self.signatures_verified += len(items)
         futs = [
             loop.run_in_executor(self._pool, verify_one, pk, msg, sig)
             for pk, msg, sig in items
@@ -111,6 +120,11 @@ class TpuBatchVerifier:
             buckets = ()
         self.buckets = tuple(sorted(set(buckets) | {batch_size}))
         self._queue: List[_Pending] = []
+        # Backpressure bound: callers await queue room instead of growing
+        # the accumulator without limit (the broadcast worker pool already
+        # self-limits; this protects against unbounded verify_many floods).
+        self.max_queue = max(8 * batch_size, 4096)
+        self._capacity = asyncio.Semaphore(self.max_queue)
         self._wakeup = asyncio.Event()
         self._device_pool = ThreadPoolExecutor(max_workers=1)
         self._closed = False
@@ -119,6 +133,27 @@ class TpuBatchVerifier:
         self.batches_dispatched = 0
         self.signatures_verified = 0
         self.total_padding = 0
+        self.total_dispatch_s = 0.0
+        self.last_dispatch_s = 0.0
+
+    def stats(self) -> dict:
+        """Operator-facing counters: batch occupancy, padding ratio, and
+        device dispatch latency (SURVEY.md §5 tracing/metrics row)."""
+        n_b = self.batches_dispatched
+        n_s = self.signatures_verified
+        return {
+            "batches": n_b,
+            "signatures": n_s,
+            "queue_depth": len(self._queue),
+            "batch_occupancy": (n_s / (n_s + self.total_padding))
+            if n_s + self.total_padding
+            else 0.0,
+            "padding_ratio": (self.total_padding / (n_s + self.total_padding))
+            if n_s + self.total_padding
+            else 0.0,
+            "avg_dispatch_ms": (1e3 * self.total_dispatch_s / n_b) if n_b else 0.0,
+            "last_dispatch_ms": 1e3 * self.last_dispatch_s,
+        }
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -127,6 +162,13 @@ class TpuBatchVerifier:
         return self.buckets[-1]
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        if self._closed:
+            raise RuntimeError("verifier closed")
+        await self._capacity.acquire()
+        if self._closed:
+            # re-release so wake-ups cascade to every parked caller
+            self._capacity.release()
+            raise RuntimeError("verifier closed")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._queue.append(
             _Pending(public_key, message, signature, fut, time.monotonic())
@@ -174,6 +216,8 @@ class TpuBatchVerifier:
                 self._queue[: self.batch_size],
                 self._queue[self.batch_size :],
             )
+            for _ in batch:
+                self._capacity.release()
             await self._dispatch(batch)
 
     def _run_batch(self, pks, msgs, sigs, bucket) -> np.ndarray:
@@ -184,17 +228,28 @@ class TpuBatchVerifier:
         return kernel.verify_batch(pks, msgs, sigs, batch_size=bucket)
 
     async def warmup(self) -> None:
-        """Compile the smallest bucket's program before serving traffic.
+        """Compile EVERY bucket's program before serving traffic.
 
         XLA/Mosaic compilation takes tens of seconds cold; a node must not
         report ready (bind its RPC port) while the first real signature
-        would stall behind the compiler. Dispatches one throwaway batch
-        through the production path and waits for it."""
+        would stall behind the compiler. Dispatches one padded throwaway
+        batch per configured bucket shape, then one request through the
+        full accumulate/flush path."""
         from .keys import SignKeyPair
 
         kp = SignKeyPair.from_hex("01" * 32)
         msg = b"verifier warmup"
-        ok = await self.verify(kp.public, msg, kp.sign(msg))
+        sig = kp.sign(msg)
+        loop = asyncio.get_running_loop()
+        for bucket in self.buckets:
+            out = await loop.run_in_executor(
+                self._device_pool, self._run_batch, [kp.public], [msg], [sig], bucket
+            )
+            if not bool(out[0]):
+                raise RuntimeError(
+                    f"verifier warm-up failed for bucket {bucket}"
+                )
+        ok = await self.verify(kp.public, msg, sig)
         if not ok:
             raise RuntimeError("verifier warm-up batch failed to verify")
 
@@ -210,6 +265,7 @@ class TpuBatchVerifier:
                 bucket,
             )
 
+        t0 = time.monotonic()
         try:
             results = await loop.run_in_executor(self._device_pool, run)
         except Exception as exc:
@@ -217,6 +273,8 @@ class TpuBatchVerifier:
                 if not p.future.done():
                     p.future.set_exception(exc)
             return
+        self.last_dispatch_s = time.monotonic() - t0
+        self.total_dispatch_s += self.last_dispatch_s
         self.batches_dispatched += 1
         self.signatures_verified += len(batch)
         self.total_padding += bucket - len(batch)
@@ -228,10 +286,19 @@ class TpuBatchVerifier:
         self._closed = True
         self._wakeup.set()
         self._flusher.cancel()
+        try:
+            await self._flusher
+        except (asyncio.CancelledError, Exception):
+            pass
         for p in self._queue:
             if not p.future.done():
                 p.future.set_exception(RuntimeError("verifier closed"))
+            self._capacity.release()
         self._queue.clear()
+        # unblock any callers parked on the capacity semaphore; they re-check
+        # _closed after acquire and raise
+        for _ in range(self.max_queue):
+            self._capacity.release()
         self._device_pool.shutdown(wait=False, cancel_futures=True)
 
 

@@ -106,11 +106,15 @@ class Mesh:
         self._closed = True
         for t in self._tasks:
             t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
         for channel in list(self._channels):
             channel.close()
         self._channels.clear()
         if self._server is not None:
             self._server.close()
+            await self._server.wait_closed()
+            self._server = None
 
     # -- sending ----------------------------------------------------------
 
@@ -142,6 +146,7 @@ class Mesh:
         backoff = 0.1
         host, port = peer.host_port()
         pending: Optional[List[bytes]] = None  # batch to resend after redial
+        held: Optional[bytes] = None  # message deferred to the next frame
         while not self._closed:
             try:
                 channel = await transport.connect(host, port, self.keypair)
@@ -164,17 +169,21 @@ class Mesh:
             try:
                 while True:
                     if pending is None:
-                        batch = [await q.get()]
-                        size = len(batch[0])
+                        first = held if held is not None else await q.get()
+                        held = None
+                        batch = [first]
+                        size = len(first)
                         # drain whatever accumulated while the last frame
-                        # was in flight (bounded)
-                        while (
-                            len(batch) < MAX_BATCH_MSGS
-                            and size < MAX_BATCH_BYTES
-                        ):
+                        # was in flight (bounded: the frame never exceeds
+                        # MAX_BATCH_BYTES — an overflowing message is held
+                        # for the next frame, not appended)
+                        while len(batch) < MAX_BATCH_MSGS:
                             try:
                                 m = q.get_nowait()
                             except asyncio.QueueEmpty:
+                                break
+                            if size + len(m) > MAX_BATCH_BYTES:
+                                held = m
                                 break
                             batch.append(m)
                             size += len(m)

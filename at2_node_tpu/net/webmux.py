@@ -1,0 +1,374 @@
+"""Single-port gRPC + grpc-web multiplexer (the browser surface).
+
+The reference serves browsers and native clients on ONE port: tonic with
+`accept_http1(true)` + `tonic_web::config().allow_all_origins()`
+(`/root/reference/src/bin/server/main.rs:110-114`), so its wasm client can
+call the node from a browser (`/root/reference/src/client.rs:45-46,58-61`).
+grpc.aio has no HTTP/1 story, so this module recreates the capability the
+transport-native way:
+
+* ``PortMux`` listens on the node's public RPC address and sniffs each
+  connection's first bytes. The HTTP/2 client preface (``PRI *
+  HTTP/2.0``) marks a native gRPC client — the connection is spliced to
+  the real grpc.aio server on an internal loopback port, bytes forwarded
+  verbatim both ways.
+* Anything else is treated as HTTP/1: an in-process grpc-web endpoint
+  decodes the grpc-web framing (binary ``application/grpc-web+proto`` and
+  base64 ``application/grpc-web-text+proto``), dispatches to the SAME
+  servicer object the gRPC server uses, and answers with CORS-allow-all
+  headers plus the grpc-web trailers frame — so any stock grpc-web client
+  (including browsers) works against the node.
+
+Only unary RPCs are implemented — exactly the surface `at2.proto` has.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+from typing import Dict, Optional, Tuple
+
+import grpc
+
+from ..proto import at2_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+# grpc-web frame flags
+_DATA_FRAME = 0x00
+_TRAILER_FRAME = 0x80
+
+_MAX_BODY = 4 * 1024 * 1024
+_MAX_HEADER = 64 * 1024
+
+# method name -> request message class (the service's reply types come
+# back from the servicer call itself)
+_REQUEST_TYPES: Dict[str, type] = {
+    "SendAsset": pb.SendAssetRequest,
+    "GetBalance": pb.GetBalanceRequest,
+    "GetLastSequence": pb.GetLastSequenceRequest,
+    "GetLatestTransactions": pb.GetLatestTransactionsRequest,
+}
+
+_CORS_HEADERS = (
+    "Access-Control-Allow-Origin: *\r\n"
+    "Access-Control-Allow-Methods: POST, OPTIONS\r\n"
+    "Access-Control-Allow-Headers: content-type, x-grpc-web, x-user-agent, grpc-timeout\r\n"
+    "Access-Control-Expose-Headers: grpc-status, grpc-message\r\n"
+)
+
+
+class _Abort(Exception):
+    """Raised by the fake context to short-circuit a handler."""
+
+    def __init__(self, code: grpc.StatusCode, details: str) -> None:
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class _WebContext:
+    """Minimal stand-in for grpc.aio.ServicerContext under grpc-web: the
+    servicer methods only use ``abort`` (see node/service.py handlers)."""
+
+    async def abort(self, code: grpc.StatusCode, details: str = "") -> None:
+        raise _Abort(code, details)
+
+
+def _frame(payload: bytes, flags: int = _DATA_FRAME) -> bytes:
+    return bytes([flags]) + len(payload).to_bytes(4, "big") + payload
+
+
+def _parse_frames(body: bytes) -> list:
+    """Split a grpc-web body into (flags, payload) tuples."""
+    out = []
+    view = memoryview(body)
+    while len(view) >= 5:
+        flags = view[0]
+        length = int.from_bytes(view[1:5], "big")
+        if len(view) < 5 + length:
+            raise ValueError("truncated grpc-web frame")
+        out.append((flags, bytes(view[5 : 5 + length])))
+        view = view[5 + length :]
+    if len(view):
+        raise ValueError("trailing bytes after grpc-web frames")
+    return out
+
+
+def _status_int(code: grpc.StatusCode) -> int:
+    return code.value[0]
+
+
+class PortMux:
+    """The public RPC listener: native gRPC spliced through, grpc-web
+    served in-process."""
+
+    def __init__(
+        self,
+        listen_addr: str,
+        grpc_port: int,
+        servicer,
+        grpc_host: str = "127.0.0.1",
+    ) -> None:
+        self.listen_addr = listen_addr
+        self.grpc_host = grpc_host
+        self.grpc_port = grpc_port
+        self.servicer = servicer
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()  # live per-connection handler tasks
+
+    async def start(self) -> None:
+        host, _, port = self.listen_addr.rpartition(":")
+        self._server = await asyncio.start_server(
+            self._handle_conn, host or "0.0.0.0", int(port)
+        )
+
+    async def close(self) -> None:
+        """Shutdown must not depend on clients hanging up: handler tasks
+        (including gRPC splices held open by lingering client channels)
+        are cancelled outright before the listener is awaited closed."""
+        if self._server is not None:
+            self._server.close()
+        for task in list(self._conns):
+            task.cancel()
+        if self._conns:
+            await asyncio.gather(*self._conns, return_exceptions=True)
+        self._conns.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns.add(task)
+        try:
+            await self._handle(reader, writer)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            if task is not None:
+                self._conns.discard(task)
+
+    # -- connection handling ---------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            # readexactly: a short first segment must not misroute a native
+            # gRPC client whose HTTP/2 preface arrives in pieces
+            head = await asyncio.wait_for(reader.readexactly(4), timeout=30)
+        except (
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+        ):
+            writer.close()
+            return
+        try:
+            if head == b"PRI ":
+                await self._splice_grpc(head, reader, writer)
+            else:
+                # header/body reads are bounded too: a stalled client must
+                # not pin a handler task on the public port (slowloris)
+                await asyncio.wait_for(
+                    self._serve_http1(head, reader, writer), timeout=30
+                )
+        except asyncio.TimeoutError:
+            pass
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            pass
+        except Exception:
+            logger.exception("webmux connection error")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _splice_grpc(
+        self,
+        head: bytes,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        """Bidirectional byte pipe to the internal grpc.aio port."""
+        up_reader, up_writer = await asyncio.open_connection(
+            self.grpc_host, self.grpc_port
+        )
+        up_writer.write(head)
+
+        async def pipe(src: asyncio.StreamReader, dst: asyncio.StreamWriter):
+            try:
+                while True:
+                    chunk = await src.read(65536)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+                    await dst.drain()
+            finally:
+                try:
+                    dst.close()
+                except Exception:
+                    pass
+
+        await asyncio.gather(
+            pipe(reader, up_writer), pipe(up_reader, writer),
+            return_exceptions=True,
+        )
+
+    # -- HTTP/1 grpc-web --------------------------------------------------
+
+    async def _serve_http1(
+        self,
+        head: bytes,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        raw = head + await self._read_until_headers_end(reader)
+        sep = raw.find(b"\r\n\r\n")
+        if sep < 0:
+            await self._respond(writer, "400 Bad Request", "text/plain", b"bad request")
+            return
+        body_prefix = raw[sep + 4 :]  # bytes past the headers already read
+        try:
+            request_line, headers = self._parse_headers(raw[:sep])
+            method, path, _version = request_line.split(" ", 2)
+        except ValueError:
+            await self._respond(writer, "400 Bad Request", "text/plain", b"bad request")
+            return
+
+        if method.upper() == "OPTIONS":
+            # CORS preflight (allow-all, reference parity)
+            writer.write(
+                (
+                    "HTTP/1.1 204 No Content\r\n"
+                    + _CORS_HEADERS
+                    + "Access-Control-Max-Age: 86400\r\n"
+                    + "Content-Length: 0\r\nConnection: close\r\n\r\n"
+                ).encode()
+            )
+            await writer.drain()
+            return
+
+        if method.upper() != "POST":
+            await self._respond(writer, "405 Method Not Allowed", "text/plain", b"")
+            return
+
+        length = int(headers.get("content-length", "0"))
+        if length > _MAX_BODY:
+            await self._respond(writer, "413 Payload Too Large", "text/plain", b"")
+            return
+        body = body_prefix[:length]
+        if len(body) < length:
+            body += await reader.readexactly(length - len(body))
+
+        content_type = headers.get("content-type", "")
+        text_mode = "grpc-web-text" in content_type
+        if "grpc-web" not in content_type:
+            await self._respond(
+                writer, "415 Unsupported Media Type", "text/plain", b""
+            )
+            return
+        if text_mode:
+            try:
+                body = base64.b64decode(body)
+            except Exception:
+                await self._respond(writer, "400 Bad Request", "text/plain", b"")
+                return
+
+        status, message, reply_bytes = await self._dispatch(path, body)
+
+        payload = b""
+        if reply_bytes is not None:
+            payload += _frame(reply_bytes)
+        trailer = f"grpc-status: {status}\r\n"
+        if message:
+            trailer += f"grpc-message: {message}\r\n"
+        payload += _frame(trailer.encode(), _TRAILER_FRAME)
+        if text_mode:
+            payload = base64.b64encode(payload)
+            reply_type = "application/grpc-web-text+proto"
+        else:
+            reply_type = "application/grpc-web+proto"
+        await self._respond(writer, "200 OK", reply_type, payload)
+
+    async def _dispatch(
+        self, path: str, body: bytes
+    ) -> Tuple[int, str, Optional[bytes]]:
+        """Decode the request, run the servicer method, encode the reply.
+        Returns (grpc-status, grpc-message, reply bytes or None)."""
+        parts = path.strip("/").split("/")
+        if len(parts) != 2 or parts[0] != "at2.AT2":
+            return _status_int(grpc.StatusCode.UNIMPLEMENTED), "unknown service", None
+        method_name = parts[1]
+        req_type = _REQUEST_TYPES.get(method_name)
+        handler = getattr(self.servicer, method_name, None)
+        if req_type is None or handler is None:
+            return _status_int(grpc.StatusCode.UNIMPLEMENTED), "unknown method", None
+        try:
+            frames = _parse_frames(body)
+            data = b"".join(p for f, p in frames if f == _DATA_FRAME)
+            request = req_type.FromString(data)
+        except Exception:
+            return (
+                _status_int(grpc.StatusCode.INVALID_ARGUMENT),
+                "malformed request",
+                None,
+            )
+        try:
+            reply = await handler(request, _WebContext())
+        except _Abort as abort:
+            return _status_int(abort.code), abort.details, None
+        except Exception:
+            logger.exception("grpc-web handler error in %s", method_name)
+            return _status_int(grpc.StatusCode.INTERNAL), "internal error", None
+        return 0, "", reply.SerializeToString()
+
+    # -- small HTTP helpers ----------------------------------------------
+
+    @staticmethod
+    async def _read_until_headers_end(reader: asyncio.StreamReader) -> bytes:
+        buf = bytearray()
+        while b"\r\n\r\n" not in buf:
+            chunk = await reader.read(4096)
+            if not chunk:
+                break
+            buf.extend(chunk)
+            if len(buf) > _MAX_HEADER:
+                raise ValueError("oversized request headers")
+        return bytes(buf)
+
+    @staticmethod
+    def _parse_headers(raw: bytes) -> Tuple[str, Dict[str, str]]:
+        header_blob = raw.split(b"\r\n\r\n", 1)[0].decode("latin-1")
+        lines = header_blob.split("\r\n")
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        return lines[0], headers
+
+    @staticmethod
+    async def _respond(
+        writer: asyncio.StreamWriter,
+        status_line: str,
+        content_type: str,
+        body: bytes,
+    ) -> None:
+        writer.write(
+            (
+                f"HTTP/1.1 {status_line}\r\n"
+                f"Content-Type: {content_type}\r\n"
+                + _CORS_HEADERS
+                + f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+            ).encode()
+            + body
+        )
+        await writer.drain()

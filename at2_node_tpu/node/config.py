@@ -15,7 +15,11 @@ Two conscious additions over the reference schema:
   channel (X25519) keys;
 * an optional `[verifier]` table — `kind = "cpu" | "tpu"`, `batch_size`,
   `max_delay` — the plugin selection the BASELINE north star requires
-  (SURVEY.md §5 "config/flag system").
+  (SURVEY.md §5 "config/flag system");
+* an optional `[observability]` table — `stats_interval` (seconds between
+  structured stats log lines; 0 disables) and `profile_dir` (when set, a
+  `jax.profiler` trace of the verifier's device work is written there) —
+  SURVEY.md §5's "per-stage counters + jax.profiler from day 1".
 """
 
 from __future__ import annotations
@@ -48,6 +52,12 @@ class VerifierConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    stats_interval: float = 0.0  # seconds between stats lines; 0 = off
+    profile_dir: str = ""  # jax.profiler trace output dir; "" = off
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -55,6 +65,9 @@ class Config:
     network_key: ExchangeKeyPair
     nodes: List[Peer] = field(default_factory=list)
     verifier: VerifierConfig = field(default_factory=VerifierConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig
+    )
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -81,6 +94,14 @@ class Config:
             f"batch_size = {self.verifier.batch_size}",
             f"max_delay = {self.verifier.max_delay}",
         ]
+        obs = self.observability
+        if obs.stats_interval or obs.profile_dir:
+            lines += [
+                "",
+                "[observability]",
+                f"stats_interval = {obs.stats_interval}",
+                f'profile_dir = "{obs.profile_dir}"',
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -95,6 +116,7 @@ class Config:
     def loads(text: str) -> "Config":
         doc = tomllib.loads(text)
         verifier = VerifierConfig(**doc.get("verifier", {}))
+        observability = ObservabilityConfig(**doc.get("observability", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -109,6 +131,7 @@ class Config:
                 for n in doc.get("nodes", [])
             ],
             verifier=verifier,
+            observability=observability,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

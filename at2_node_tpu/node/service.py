@@ -38,6 +38,7 @@ from ..crypto.verifier import Verifier
 from ..ledger.accounts import AccountModificationError, Accounts
 from ..ledger.recent import RecentTransactions
 from ..net.peers import Mesh
+from ..net.webmux import PortMux
 from ..proto import at2_pb2 as pb
 from ..proto.rpc import At2Servicer, add_to_server
 from ..types import ThinTransaction, TransactionState, rfc3339
@@ -45,7 +46,24 @@ from .config import Config
 
 logger = logging.getLogger(__name__)
 
+# Dedicated stats logger with its own INFO handler: operator-enabled stats
+# must be visible even under the reference-parity WARN default
+# (/root/reference/src/bin/server/main.rs:94-99). Configured lazily by
+# _enable_stats_logging so library users keep full control otherwise.
+stats_logger = logging.getLogger("at2_node_tpu.stats")
+
 TRANSACTION_TTL = 60.0  # seconds, rpc.rs:35
+
+
+def _enable_stats_logging() -> None:
+    if not stats_logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(message)s")
+        )
+        stats_logger.addHandler(handler)
+        stats_logger.setLevel(logging.INFO)
+        stats_logger.propagate = False
 
 
 class Service(At2Servicer):
@@ -59,7 +77,12 @@ class Service(At2Servicer):
         self.mesh: Optional[Mesh] = None
         self.broadcast: Optional[Broadcast] = None
         self._grpc_server: Optional[grpc.aio.Server] = None
+        self._mux: Optional[PortMux] = None
         self._delivery_task: Optional[asyncio.Task] = None
+        self._stats_task: Optional[asyncio.Task] = None
+        self._profiling = False
+        self._owns_verifier = True
+        self.committed = 0  # payloads committed to the ledger
         # leftovers: (key, arrival, tiebreak, payload) carried across batches
         self._heap: List[tuple] = []
         self._push_count = 0  # monotonic heap tiebreaker
@@ -67,18 +90,26 @@ class Service(At2Servicer):
     # -- lifecycle --------------------------------------------------------
 
     @staticmethod
-    async def start(config: Config) -> "Service":
+    async def start(config: Config, verifier: Optional[Verifier] = None) -> "Service":
+        """Bring up one node. ``verifier`` injects a SHARED verifier (the
+        BASELINE config-5 shape: many nodes feeding one device pool —
+        `parallel.pool.PoolVerifier`); the caller keeps ownership and
+        closes it after every sharing node is down."""
         service = Service(config)
-        service.verifier = config.verifier.make()
-        # Compile the device verifier BEFORE binding the RPC port: a node
-        # is not ready while its first signature check would stall tens of
-        # seconds behind XLA compilation (readiness probes poll the port —
-        # tests/shell/lib.sh, /root/reference/tests/cli.rs:119-131).
-        try:
-            await service.verifier.warmup()
-        except Exception:
-            await service.verifier.close()
-            raise
+        if verifier is not None:
+            service.verifier = verifier
+            service._owns_verifier = False
+        else:
+            service.verifier = config.verifier.make()
+            # Compile the device verifier BEFORE binding the RPC port: a
+            # node is not ready while its first signature check would stall
+            # tens of seconds behind XLA compilation (readiness probes poll
+            # the port — tests/shell/lib.sh, reference tests/cli.rs:119-131).
+            try:
+                await service.verifier.warmup()
+            except Exception:
+                await service.verifier.close()
+                raise
         service.mesh = Mesh(
             config.node_address,
             config.network_key,
@@ -96,14 +127,36 @@ class Service(At2Servicer):
         await service.broadcast.start()
         service._delivery_task = asyncio.create_task(service._delivery_loop())
 
+        obs = config.observability
+        if obs.stats_interval > 0:
+            _enable_stats_logging()
+            service._stats_task = asyncio.create_task(
+                service._stats_loop(obs.stats_interval)
+            )
+        if obs.profile_dir:
+            import jax
+
+            jax.profiler.start_trace(obs.profile_dir)
+            service._profiling = True
+
+        # The public RPC port is a mux (reference parity: tonic serves
+        # native gRPC AND grpc-web/HTTP1/CORS on one port, main.rs:110-114):
+        # grpc.aio binds an internal loopback port; the mux splices HTTP/2
+        # clients to it and answers grpc-web itself.
         server = grpc.aio.server()
         add_to_server(service, server)
-        bound = server.add_insecure_port(config.rpc_address)
-        if bound == 0:
+        internal_port = server.add_insecure_port("127.0.0.1:0")
+        if internal_port == 0:
             await service.close()
-            raise OSError(f"cannot bind rpc address {config.rpc_address}")
+            raise OSError("cannot bind internal grpc port")
         await server.start()
         service._grpc_server = server
+        service._mux = PortMux(config.rpc_address, internal_port, service)
+        try:
+            await service._mux.start()
+        except OSError:
+            await service.close()
+            raise OSError(f"cannot bind rpc address {config.rpc_address}")
         logger.info(
             "node up: mesh on %s, rpc on %s, %d peers, verifier=%s",
             config.node_address,
@@ -117,16 +170,61 @@ class Service(At2Servicer):
         await self._grpc_server.wait_for_termination()
 
     async def close(self) -> None:
+        if self._profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profiling = False
+        if self._stats_task is not None:
+            self._stats_task.cancel()
+            try:
+                await self._stats_task
+            except asyncio.CancelledError:
+                pass
+        if self._mux is not None:
+            await self._mux.close()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)
         if self._delivery_task is not None:
             self._delivery_task.cancel()
+            try:
+                await self._delivery_task
+            except asyncio.CancelledError:
+                pass
         if self.broadcast is not None:
             await self.broadcast.close()
         if self.mesh is not None:
             await self.mesh.close()
-        if self.verifier is not None:
+        if self.verifier is not None and self._owns_verifier:
             await self.verifier.close()
+
+    # -- observability ---------------------------------------------------
+
+    def snapshot_stats(self) -> dict:
+        """One structured stats record: broadcast per-stage counters +
+        verifier batch metrics + commit progress (SURVEY.md §5)."""
+        out = {"committed": self.committed, "pending": len(self._heap)}
+        if self.broadcast is not None:
+            out.update(self.broadcast.stats)
+        if self.verifier is not None:
+            verifier_stats = getattr(self.verifier, "stats", None)
+            if callable(verifier_stats):
+                out.update(
+                    {f"verifier_{k}": v for k, v in verifier_stats().items()}
+                )
+        return out
+
+    async def _stats_loop(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            snap = self.snapshot_stats()
+            stats_logger.info(
+                "stats %s",
+                " ".join(
+                    f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in sorted(snap.items())
+                ),
+            )
 
     # -- delivery → commit loop ------------------------------------------
 
@@ -201,6 +299,7 @@ class Service(At2Servicer):
         await self.recent.update(
             payload.sender, payload.sequence, TransactionState.SUCCESS
         )
+        self.committed += 1
 
     # -- gRPC handlers (rpc.rs:256-344) ----------------------------------
 

@@ -246,6 +246,37 @@ class TestSingleNode:
             await channel.close()
 
 
+class TestObservability:
+    async def test_stats_snapshot_and_periodic_log(self, caplog):
+        import logging
+
+        from at2_node_tpu.node.config import ObservabilityConfig
+        from at2_node_tpu.node.service import stats_logger
+
+        net = Network(1)
+        net.configs[0].observability = ObservabilityConfig(stats_interval=0.2)
+        propagate_before = stats_logger.propagate
+        try:
+            async with net:
+                stats_logger.propagate = True  # let caplog see the records
+                async with Client(net.rpc_url()) as client:
+                    with caplog.at_level(logging.INFO, logger="at2_node_tpu.stats"):
+                        sender, recipient = SignKeyPair.random(), SignKeyPair.random()
+                        await client.send_asset(sender, 1, recipient.public, 7)
+                        await wait_for_sequence(client, sender.public, 1)
+                        await asyncio.sleep(0.5)  # at least one stats tick
+                snap = net.services[0].snapshot_stats()
+                assert snap["committed"] == 1
+                assert snap["delivered"] == 1
+                assert snap["verifier_signatures"] >= 1
+                stats_lines = [
+                    r.message for r in caplog.records if "committed=" in r.message
+                ]
+                assert stats_lines, "no periodic stats line was logged"
+        finally:
+            stats_logger.propagate = propagate_before
+
+
 class TestMultiNode:
     async def test_three_node_boot(self):
         # cli.rs:210-213 can_run_network

@@ -1,0 +1,175 @@
+"""grpc-web / HTTP1 / CORS browser-surface tests (reference parity:
+`/root/reference/src/bin/server/main.rs:110-114` serves tonic-web with
+`accept_http1(true)` and CORS allow-all on the same port as native gRPC).
+
+The calls here speak raw HTTP/1.1 + grpc-web framing over a plain TCP
+socket — exactly what a browser grpc-web client emits — against the same
+public RPC port the native gRPC tests use (the PortMux splices the two
+protocols)."""
+
+import asyncio
+import base64
+import itertools
+
+from at2_node_tpu.client import Client
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.net.peers import Peer
+from at2_node_tpu.net.webmux import _DATA_FRAME, _TRAILER_FRAME, _frame, _parse_frames
+from at2_node_tpu.node.config import Config
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.proto import at2_pb2 as pb
+from at2_node_tpu.types import ThinTransaction
+
+_ports = itertools.count(45100)
+
+
+def _single_node_config():
+    return Config(
+        node_address=f"127.0.0.1:{next(_ports)}",
+        rpc_address=f"127.0.0.1:{next(_ports)}",
+        sign_key=SignKeyPair.random(),
+        network_key=ExchangeKeyPair.random(),
+    )
+
+
+async def _http1(addr: str, request: bytes) -> tuple:
+    """One raw HTTP/1.1 exchange; returns (status_line, headers, body)."""
+    host, _, port = addr.rpartition(":")
+    reader, writer = await asyncio.open_connection(host, int(port))
+    writer.write(request)
+    await writer.drain()
+    raw = await reader.read(-1)  # server closes after responding
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    headers = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    return lines[0], headers, body
+
+
+async def _grpc_web_call(addr: str, method: str, request_msg, text=False):
+    """Unary grpc-web call; returns (grpc_status, reply_bytes|None)."""
+    body = _frame(request_msg.SerializeToString())
+    ctype = "application/grpc-web+proto"
+    if text:
+        body = base64.b64encode(body)
+        ctype = "application/grpc-web-text+proto"
+    req = (
+        f"POST /at2.AT2/{method} HTTP/1.1\r\n"
+        f"Host: node\r\nContent-Type: {ctype}\r\n"
+        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+    ).encode() + body
+    status_line, headers, payload = await _http1(addr, req)
+    assert "200" in status_line, status_line
+    assert headers.get("access-control-allow-origin") == "*"
+    if text:
+        payload = base64.b64decode(payload)
+    reply = None
+    grpc_status = None
+    for flags, data in _parse_frames(payload):
+        if flags == _DATA_FRAME:
+            reply = data
+        elif flags == _TRAILER_FRAME:
+            for line in data.decode().split("\r\n"):
+                if line.lower().startswith("grpc-status:"):
+                    grpc_status = int(line.split(":", 1)[1])
+    return grpc_status, reply
+
+
+class TestGrpcWeb:
+    async def test_cors_preflight(self):
+        cfg = _single_node_config()
+        service = await Service.start(cfg)
+        try:
+            req = (
+                "OPTIONS /at2.AT2/SendAsset HTTP/1.1\r\nHost: node\r\n"
+                "Origin: http://example.com\r\n"
+                "Access-Control-Request-Method: POST\r\n\r\n"
+            ).encode()
+            status_line, headers, _ = await _http1(cfg.rpc_address, req)
+            assert "204" in status_line
+            assert headers["access-control-allow-origin"] == "*"
+            assert "post" in headers["access-control-allow-methods"].lower()
+        finally:
+            await service.close()
+
+    async def test_send_asset_and_read_back_over_grpc_web(self):
+        cfg = _single_node_config()
+        service = await Service.start(cfg)
+        try:
+            sender, recipient = SignKeyPair.random(), SignKeyPair.random()
+            thin = ThinTransaction(recipient.public, 77)
+            request = pb.SendAssetRequest(
+                sender=sender.public,
+                sequence=1,
+                recipient=recipient.public,
+                amount=77,
+                signature=sender.sign(thin.signing_bytes()),
+            )
+            status, reply = await _grpc_web_call(
+                cfg.rpc_address, "SendAsset", request
+            )
+            assert status == 0 and reply is not None
+
+            # poll commit via grpc-web GetLastSequence (binary mode)
+            deadline = asyncio.get_event_loop().time() + 10
+            seq = 0
+            while asyncio.get_event_loop().time() < deadline:
+                status, reply = await _grpc_web_call(
+                    cfg.rpc_address,
+                    "GetLastSequence",
+                    pb.GetLastSequenceRequest(sender=sender.public),
+                )
+                assert status == 0
+                seq = pb.GetLastSequenceReply.FromString(reply).sequence
+                if seq == 1:
+                    break
+                await asyncio.sleep(0.1)
+            assert seq == 1
+
+            # GetLatestTransactions over grpc-web-TEXT mode (the framing a
+            # browser uses when fetch streaming is unavailable)
+            status, reply = await _grpc_web_call(
+                cfg.rpc_address,
+                "GetLatestTransactions",
+                pb.GetLatestTransactionsRequest(),
+                text=True,
+            )
+            assert status == 0
+            txs = pb.GetLatestTransactionsReply.FromString(reply).transactions
+            assert len(txs) == 1 and txs[0].amount == 77
+        finally:
+            await service.close()
+
+    async def test_native_grpc_still_served_on_same_port(self):
+        # the splice path: a stock gRPC client on the muxed public port
+        cfg = _single_node_config()
+        service = await Service.start(cfg)
+        try:
+            async with Client(f"http://{cfg.rpc_address}") as client:
+                user = SignKeyPair.random()
+                assert await client.get_balance(user.public) == 100_000
+        finally:
+            await service.close()
+
+    async def test_grpc_web_error_paths(self):
+        cfg = _single_node_config()
+        service = await Service.start(cfg)
+        try:
+            # unknown method -> UNIMPLEMENTED (12) in the trailers
+            status, reply = await _grpc_web_call(
+                cfg.rpc_address, "NoSuchMethod", pb.GetBalanceRequest()
+            )
+            assert status == 12 and reply is None
+            # handler abort -> INVALID_ARGUMENT (3)
+            bad = pb.SendAssetRequest(
+                sender=b"short", sequence=1, recipient=b"r" * 32,
+                amount=1, signature=b"s" * 64,
+            )
+            status, _ = await _grpc_web_call(cfg.rpc_address, "SendAsset", bad)
+            assert status == 3
+        finally:
+            await service.close()
