@@ -41,7 +41,16 @@ N_WINDOWS = 64  # 256 bits / 4-bit windows
 
 # Fixed batch buckets: one compiled XLA program per size; every batch is
 # padded up to a bucket so traffic jitter never triggers a recompile.
-BUCKETS = (64, 256, 1024, 4096, 8192)
+# 65536 exists for firehose/offline loads: the tunnel/host-device sync
+# cost is per-dispatch, so the biggest bucket amortizes it 8x better than
+# 8192 (see bench.py's transfer analysis).
+BUCKETS = (64, 256, 1024, 4096, 8192, 65536)
+
+# One packed input row per lane: a(32) | r(32) | s(32) | h(32) | valid(1).
+# A batch crosses host->device as a single (B, PACKED_WIDTH) uint8 array —
+# one transfer instead of five, because every host<->device round trip
+# through a tunnelled chip pays a fixed sync cost that dwarfs bandwidth.
+PACKED_WIDTH = 129
 
 
 def bucket_for(n: int) -> int:
@@ -141,6 +150,25 @@ def prepare_batch_py(
     return (a_bytes, r_bytes, s_le, h_le, valid)
 
 
+def pack_prepared(a, r, s_le, h_le, valid) -> np.ndarray:
+    """Host-side: fuse the five prepared arrays into one (B, 129) uint8
+    row-per-lane array (single H2D transfer)."""
+    return np.concatenate(
+        [a, r, s_le, h_le, valid[:, None].astype(np.uint8)], axis=1
+    )
+
+
+def unpack_packed(packed: jnp.ndarray):
+    """In-graph: split a (B, 129) packed batch back into kernel inputs."""
+    return (
+        packed[:, :32],
+        packed[:, 32:64],
+        packed[:, 64:96],
+        packed[:, 96:128],
+        packed[:, 128].astype(jnp.bool_),
+    )
+
+
 def verify_kernel(
     a_bytes: jnp.ndarray,
     r_bytes: jnp.ndarray,
@@ -165,6 +193,13 @@ def verify_kernel(
 
 
 _verify_jit = jax.jit(verify_kernel)
+
+
+def verify_kernel_packed(packed: jnp.ndarray) -> jnp.ndarray:
+    return verify_kernel(*unpack_packed(packed))
+
+
+_verify_packed_jit = jax.jit(verify_kernel_packed)
 
 
 def _use_pallas() -> bool:
@@ -200,11 +235,5 @@ def verify_batch(
     a, r, s_le, h_le, valid = prepare_batch(
         public_keys, messages, signatures, batch_size
     )
-    out = _verify_jit(
-        jnp.asarray(a),
-        jnp.asarray(r),
-        jnp.asarray(s_le),
-        jnp.asarray(h_le),
-        jnp.asarray(valid),
-    )
+    out = _verify_packed_jit(jnp.asarray(pack_prepared(a, r, s_le, h_le, valid)))
     return np.asarray(out)[: len(public_keys)]

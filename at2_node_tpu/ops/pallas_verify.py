@@ -369,6 +369,22 @@ def verify_graph(a_bytes, r_bytes, s_le, h_le, valid, interpret=False, tile=TILE
 _verify_pallas = jax.jit(verify_graph, static_argnames=("interpret", "tile"))
 
 
+def verify_graph_packed(packed, interpret=False, tile=TILE):
+    """verify_graph on a single packed (B, 129) uint8 input — ONE host->
+    device transfer per batch. Through a tunnelled chip every transfer
+    pays a fixed sync cost, so fusing the five inputs into one array is
+    worth more than it looks (see bench.py's transfer analysis)."""
+    from .ed25519 import unpack_packed
+
+    a, r, s_le, h_le, valid = unpack_packed(packed)
+    return verify_graph(a, r, s_le, h_le, valid, interpret=interpret, tile=tile)
+
+
+_verify_pallas_packed = jax.jit(
+    verify_graph_packed, static_argnames=("interpret", "tile")
+)
+
+
 def verify_batch_pallas(
     public_keys, messages, signatures, batch_size=None, interpret=False
 ):
@@ -392,15 +408,13 @@ def verify_batch_pallas(
     batch_size = max(batch_size, tile)
     if batch_size % tile:
         batch_size = ((batch_size + tile - 1) // tile) * tile
+    from .ed25519 import pack_prepared
+
     a, r, s_le, h_le, valid = prepare_batch(
         public_keys, messages, signatures, batch_size
     )
-    out = _verify_pallas(
-        jnp.asarray(a),
-        jnp.asarray(r),
-        jnp.asarray(s_le),
-        jnp.asarray(h_le),
-        jnp.asarray(valid),
+    out = _verify_pallas_packed(
+        jnp.asarray(pack_prepared(a, r, s_le, h_le, valid)),
         interpret=interpret,
         tile=tile,
     )
