@@ -1,0 +1,232 @@
+"""BASELINE configs 1 & 3 — 4-node end-to-end send-asset throughput.
+
+Two phases, one JSON artifact (committed as BENCH_E2E.json):
+
+* **cpu_subprocess** (config 1, the reference's execution model): four
+  REAL `server` processes bootstrapped exactly like the operator workflow
+  (`config new` + concatenated `config get-node` fragments over stdin),
+  CPU verifier, driven by the gRPC load generator. This is the number to
+  compare against the reference's tokio/rust runtime on equal hardware.
+* **tpu_inprocess** (config 3, the TPU-native model): four nodes in one
+  process SHARING one `TpuBatchVerifier` (batch_size=256) — the only
+  sane topology when one host owns one chip — 16-client firehose; the
+  artifact records the verifier's batch occupancy and dispatch latency
+  alongside committed tx/s, plus per-stage broadcast counters for the
+  bottleneck analysis.
+
+The artifact also records the host context (CPU count), because the
+broadcast plane is quadratic in nodes: a 4-node full-quorum commit costs
+~28 signature verifications and ~44 protocol messages across the net,
+all of which share this machine's core(s) with the clients and the
+loadgen itself.
+
+Usage:
+    python -m at2_node_tpu.tools.e2e_bench [--clients 16]
+        [--tx-per-client 50] [--skip-cpu] [--skip-tpu] [--out BENCH_E2E.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+SERVER = [sys.executable, "-m", "at2_node_tpu.cli.server"]
+
+_ports = itertools.count(46000)
+
+
+def _run_cli(argv, stdin=None) -> str:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        argv, input=stdin, capture_output=True, text=True, env=env, timeout=60
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"{argv} failed: {proc.stderr}")
+    return proc.stdout
+
+
+def _wait_port(port: int, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"port {port} never came up")
+
+
+def _phase_cpu_subprocess(n_nodes: int, clients: int, tx_per_client: int) -> dict:
+    from .loadgen import run_load
+
+    ports = [(next(_ports), next(_ports)) for _ in range(n_nodes)]
+    configs = [
+        _run_cli(SERVER + ["config", "new", f"127.0.0.1:{np}", f"127.0.0.1:{rp}"])
+        for np, rp in ports
+    ]
+    fragments = [
+        _run_cli(SERVER + ["config", "get-node"], stdin=cfg) for cfg in configs
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs: List[subprocess.Popen] = []
+    try:
+        for i, cfg in enumerate(configs):
+            full = cfg + "\n" + "\n".join(
+                f for j, f in enumerate(fragments) if j != i
+            )
+            p = subprocess.Popen(
+                SERVER + ["run"],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                text=True,
+                env=env,
+            )
+            p.stdin.write(full)
+            p.stdin.close()
+            procs.append(p)
+        for np, rp in ports:
+            _wait_port(np)
+            _wait_port(rp)
+        rpcs = [f"http://127.0.0.1:{rp}" for _, rp in ports]
+        result = asyncio.run(
+            run_load(
+                rpcs,
+                clients=clients,
+                tx_per_client=tx_per_client,
+                window=8,
+                commit_timeout=600.0,
+            )
+        )
+        return {
+            "nodes": n_nodes,
+            "topology": "4 server subprocesses, CPU verifier",
+            "clients": clients,
+            "submitted": result.submitted,
+            "committed": result.committed,
+            "commit_seconds": round(result.commit_seconds, 2),
+            "committed_tx_per_sec": round(result.committed_tx_per_sec, 1),
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+async def _phase_tpu_inprocess(
+    n_nodes: int, clients: int, tx_per_client: int
+) -> dict:
+    from ..crypto.keys import ExchangeKeyPair, SignKeyPair
+    from ..crypto.verifier import TpuBatchVerifier
+    from ..net.peers import Peer
+    from ..node.config import Config
+    from ..node.service import Service
+    from .loadgen import run_load
+
+    shared = TpuBatchVerifier(batch_size=256, max_delay=0.005)
+    await shared.warmup()
+    cfgs = [
+        Config(
+            node_address=f"127.0.0.1:{next(_ports)}",
+            rpc_address=f"127.0.0.1:{next(_ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+        )
+        for _ in range(n_nodes)
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.nodes = [
+            Peer(o.node_address, o.network_key.public, o.sign_key.public)
+            for j, o in enumerate(cfgs)
+            if j != i
+        ]
+    services: List[Service] = []
+    try:
+        for cfg in cfgs:
+            services.append(await Service.start(cfg, verifier=shared))
+        rpcs = [f"http://{c.rpc_address}" for c in cfgs]
+        result = await run_load(
+            rpcs,
+            clients=clients,
+            tx_per_client=tx_per_client,
+            window=8,
+            commit_timeout=600.0,
+        )
+        vstats = shared.stats()
+        bstats = services[0].snapshot_stats()
+        return {
+            "nodes": n_nodes,
+            "topology": "4 in-process nodes sharing one TpuBatchVerifier",
+            "clients": clients,
+            "submitted": result.submitted,
+            "committed": result.committed,
+            "commit_seconds": round(result.commit_seconds, 2),
+            "committed_tx_per_sec": round(result.committed_tx_per_sec, 1),
+            "verifier": {
+                "batches": vstats["batches"],
+                "signatures": vstats["signatures"],
+                "batch_occupancy": round(vstats["batch_occupancy"], 4),
+                "avg_dispatch_ms": round(vstats["avg_dispatch_ms"], 2),
+            },
+            "node0_broadcast_stats": {
+                k: bstats[k]
+                for k in ("gossip_rx", "echo_rx", "ready_rx", "delivered")
+                if k in bstats
+            },
+        }
+    finally:
+        for s in services:
+            await s.close()
+        await shared.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--tx-per-client", type=int, default=50)
+    ap.add_argument("--skip-cpu", action="store_true")
+    ap.add_argument("--skip-tpu", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    artifact = {
+        "config": "BASELINE-1/3: 4-node net under send-asset load",
+        "host_cpus": os.cpu_count(),
+        "target_tx_per_sec": 10_000,
+    }
+    if not args.skip_cpu:
+        artifact["cpu_subprocess"] = _phase_cpu_subprocess(
+            args.nodes, args.clients, args.tx_per_client
+        )
+    if not args.skip_tpu:
+        artifact["tpu_inprocess"] = asyncio.run(
+            _phase_tpu_inprocess(args.nodes, args.clients, args.tx_per_client)
+        )
+    out = json.dumps(artifact)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
