@@ -18,11 +18,38 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Persistent XLA compilation cache: the crypto graphs take tens of seconds
+# to compile; caching them across test processes/runs cuts the kernel test
+# tier from ~19 minutes to seconds on re-runs (round-1 weak item #7).
+_CACHE_DIR = os.path.join(_REPO, ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run the coroutine test on a fresh event loop")
+    config.addinivalue_line("markers", "slow: long-running (interpreter-mode Pallas, big compiles); deselect with -m 'not slow'")
+
+
+async def _run_with_watchdog(coro, timeout=900):
+    """Turn async-test hangs into failures with task stacks (a real hang
+    once cost a whole CI run; XLA compiles inside async tests can
+    legitimately take minutes on one core, hence the generous bound)."""
+    task = asyncio.ensure_future(coro)
+    done, pending = await asyncio.wait({task}, timeout=timeout)
+    if pending:
+        import sys
+
+        print("\n=== WATCHDOG: test hung; task stacks ===", file=sys.stderr)
+        for t in asyncio.all_tasks():
+            print("--- task:", t.get_name(), file=sys.stderr)
+            t.print_stack(file=sys.stderr)
+        task.cancel()
+        raise TimeoutError("async test hung (watchdog)")
+    return task.result()
 
 
 def pytest_pyfunc_call(pyfuncitem):
@@ -34,6 +61,6 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(fn(**kwargs))
+        asyncio.run(_run_with_watchdog(fn(**kwargs)))
         return True
     return None

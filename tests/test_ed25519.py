@@ -109,6 +109,7 @@ def test_decompress_rejects_bad_encodings():
         assert ok[2]
 
 
+@pytest.mark.slow
 def test_double_scalar_mul_vs_oracle():
     j_dsm = jax.jit(ed.double_scalar_mul_vs_base)
     ks_a = [3, 2**64 + 5]

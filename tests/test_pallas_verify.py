@@ -7,11 +7,16 @@ non-canonical inputs (SURVEY.md §4: CPU-vs-TPU differential tests).
 """
 
 import numpy as np
+import pytest
 
 from at2_node_tpu.crypto.keys import SignKeyPair, verify_one
 from at2_node_tpu.ops import ed25519 as v
 from at2_node_tpu.ops import field as fe
 from at2_node_tpu.ops.pallas_verify import verify_batch_pallas
+
+# Interpreter-mode Pallas is minutes-slow on CPU; the whole module is part
+# of the kernel tier (`-m slow`), not the fast dev loop.
+pytestmark = pytest.mark.slow
 
 RNG = np.random.default_rng(0xA11A5)
 

@@ -41,6 +41,7 @@ def test_pool_bucket_rounds_to_device_multiple():
     assert pool.pool_bucket_for(3, 3) == 66
 
 
+@pytest.mark.slow
 def test_sharded_verify_matches_ground_truth():
     pks, msgs, sigs = _sigs(24, tamper_every=5)
     out = pool.verify_batch_sharded(pks, msgs, sigs)
@@ -49,6 +50,7 @@ def test_sharded_verify_matches_ground_truth():
     assert (out == expected).all()
 
 
+@pytest.mark.slow
 def test_sharded_count_collective():
     """The replicated valid-count output exercises the cross-device
     reduction (AllReduce on real hardware)."""
@@ -67,6 +69,7 @@ def test_sharded_count_collective():
     assert np.asarray(ok)[:16].sum() == 12
 
 
+@pytest.mark.slow
 @pytest.mark.asyncio
 async def test_pool_verifier_async():
     pks, msgs, sigs = _sigs(20, tamper_every=7)
@@ -80,6 +83,7 @@ async def test_pool_verifier_async():
         await v.close()
 
 
+@pytest.mark.slow
 def test_make_verifier_pool_kind():
     from at2_node_tpu.crypto.verifier import make_verifier
 
