@@ -51,6 +51,22 @@ class Accounts:
     def close(self) -> None:
         """Kept for API symmetry with heavier backends; nothing to stop."""
 
+    async def export_state(self) -> dict:
+        """Snapshot for checkpointing: {hex pubkey: [last_sequence, balance]}."""
+        async with self._lock:
+            return {
+                user.hex(): [a.last_sequence, a.balance]
+                for user, a in self._ledger.items()
+            }
+
+    async def import_state(self, data: dict) -> None:
+        """Replace the ledger with a checkpoint snapshot (resume-on-start)."""
+        async with self._lock:
+            self._ledger = {
+                bytes.fromhex(user): Account(last_sequence=seq, balance=bal)
+                for user, (seq, bal) in data.items()
+            }
+
     async def get_balance(self, user: bytes) -> int:
         async with self._lock:
             account = self._ledger.get(user)

@@ -62,6 +62,39 @@ class RecentTransactions:
                     tx.state = state
                     return
 
+    async def export_state(self) -> list:
+        """Snapshot for checkpointing (JSON-safe rows, oldest first)."""
+        from ..types import rfc3339
+
+        async with self._lock:
+            return [
+                [
+                    rfc3339(tx.timestamp),
+                    tx.sender.hex(),
+                    tx.sender_sequence,
+                    tx.recipient.hex(),
+                    tx.amount,
+                    tx.state.value,
+                ]
+                for tx in self._ring
+            ]
+
+    async def import_state(self, rows: list) -> None:
+        from ..types import parse_rfc3339
+
+        async with self._lock:
+            self._ring = deque(
+                FullTransaction(
+                    timestamp=parse_rfc3339(ts),
+                    sender=bytes.fromhex(sender),
+                    sender_sequence=seq,
+                    recipient=bytes.fromhex(recipient),
+                    amount=amount,
+                    state=TransactionState(state),
+                )
+                for ts, sender, seq, recipient, amount, state in rows
+            )
+
     async def get_all(self) -> List[FullTransaction]:
         async with self._lock:
             # Deep snapshot, like the reference's `self.0.clone()`

@@ -19,7 +19,11 @@ Two conscious additions over the reference schema:
 * an optional `[observability]` table — `stats_interval` (seconds between
   structured stats log lines; 0 disables) and `profile_dir` (when set, a
   `jax.profiler` trace of the verifier's device work is written there) —
-  SURVEY.md §5's "per-stage counters + jax.profiler from day 1".
+  SURVEY.md §5's "per-stage counters + jax.profiler from day 1";
+* an optional `[checkpoint]` table — `path` (ledger snapshot file;
+  restored on start when present) and `interval` (seconds between
+  snapshots) — implements the reference's open "store state on disk to
+  restart after crash" roadmap item (`/root/reference/README.md:52`).
 """
 
 from __future__ import annotations
@@ -58,6 +62,12 @@ class ObservabilityConfig:
 
 
 @dataclass
+class CheckpointConfig:
+    path: str = ""  # ledger snapshot file; "" disables checkpointing
+    interval: float = 30.0  # seconds between periodic snapshots
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -68,6 +78,7 @@ class Config:
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig
     )
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -102,6 +113,13 @@ class Config:
                 f"stats_interval = {obs.stats_interval}",
                 f'profile_dir = "{obs.profile_dir}"',
             ]
+        if self.checkpoint.path:
+            lines += [
+                "",
+                "[checkpoint]",
+                f'path = "{self.checkpoint.path}"',
+                f"interval = {self.checkpoint.interval}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -117,6 +135,7 @@ class Config:
         doc = tomllib.loads(text)
         verifier = VerifierConfig(**doc.get("verifier", {}))
         observability = ObservabilityConfig(**doc.get("observability", {}))
+        ckpt = CheckpointConfig(**doc.get("checkpoint", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -132,6 +151,7 @@ class Config:
             ],
             verifier=verifier,
             observability=observability,
+            checkpoint=ckpt,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

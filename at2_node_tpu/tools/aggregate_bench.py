@@ -13,11 +13,11 @@ Echo-quorum certificate and records which one
   certificate-level verdict only; culprits need a fallback pass.
 
 Route measurements run in SUBPROCESSES so each gets a fresh backend and a
-wall-clock bound: the RLC graph (double-table Straus + [L]P torsion sweep
-+ reduction tree) is a pathological XLA-TPU compile — on this host it did
-not finish compiling within 30 minutes, which is itself routing data —
-so by default the aggregate route is measured on the CPU backend while
-the per-sig route runs on the default (TPU) backend.
+wall-clock bound (the round-2 attempt to compile the RLC graph on the
+tunnelled TPU never completed, though the tunnel itself failed during
+that window, so device-compile feasibility is unresolved). By default the
+aggregate route is measured on the CPU backend while the per-sig route
+runs on the default (TPU) backend; --aggregate-on-device overrides.
 
 Output: one JSON line (optionally --out FILE) with steady-state
 latencies, verdicts, and the routing decision that
@@ -38,6 +38,11 @@ ROUNDS = 20
 
 _CHILD = """
 import json, time, sys
+if sys.argv[4] == "cpu":
+    # env vars are clobbered by this environment's jax-preloading .pth
+    # hook, so the backend must be retargeted via jax.config
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 from at2_node_tpu.crypto.keys import SignKeyPair
 from at2_node_tpu.ops import ed25519 as kernel
 from at2_node_tpu.ops.aggregate import aggregate_verify
@@ -69,15 +74,12 @@ print(json.dumps({"ms": round(ms, 2), "device": jax.devices()[0].platform}))
 
 
 def _measure(route: str, n: int, rounds: int, cpu: bool, timeout: float) -> dict:
-    env = dict(os.environ)
-    if cpu:
-        env["JAX_PLATFORMS"] = "cpu"
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", _CHILD, route, str(n), str(rounds)],
+            [sys.executable, "-c", _CHILD, route, str(n), str(rounds),
+             "cpu" if cpu else "default"],
             capture_output=True,
             text=True,
-            env=env,
             timeout=timeout,
             cwd=os.path.dirname(
                 os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -123,11 +125,11 @@ def main(argv=None) -> int:
         "rlc_aggregate": aggregate,
         "winner": winner,
         "notes": (
-            "The RLC route now includes the mandatory small-order subgroup "
-            "sweep ([L]R,[L]A), which alone is more device work than the "
-            "per-sig kernel's single Straus pass at n=64; its XLA-TPU "
-            "compile also exceeded a 30-minute budget on this host, so the "
-            "aggregate number is taken on the CPU backend."
+            "The RLC route includes the mandatory small-order subgroup "
+            "sweep ([L]R over 2n lanes), which alone exceeds the per-sig "
+            "kernel's single Straus pass over n lanes at n=64 — the "
+            "aggregate can only win when its one-equation saving beats "
+            "that extra sweep, which structurally requires much larger n."
         ),
         "routing": (
             "verify_certificate routes certificates through the per-sig "

@@ -163,5 +163,45 @@ def main() -> None:
     )
 
 
+def _guarded() -> None:
+    """Run the real bench in a child with a wall-clock bound; the driver
+    must ALWAYS get one JSON line even if the device tunnel wedges (a
+    hung backend init otherwise turns the round's bench into nothing)."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("AT2_BENCH_CHILD") == "1":
+        main()
+        return
+    env = dict(os.environ, AT2_BENCH_CHILD="1")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=2700,
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+        if proc.returncode == 0 and lines:
+            print(lines[-1])
+            return
+        error = f"bench child rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        error = "bench child exceeded 2700s (device tunnel unreachable?)"
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verifies_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "sigs/s",
+                "vs_baseline": 0.0,
+                "error": error,
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    _guarded()

@@ -1,0 +1,107 @@
+"""Checkpoint/resume tests: ledger survives a node restart.
+
+The reference has NO persistence — "store state on disk to restart after
+crash" is an open roadmap item (`/root/reference/README.md:52`); these
+tests pin this build's implementation of it."""
+
+import asyncio
+import itertools
+import json
+
+import pytest
+
+from at2_node_tpu.client import Client
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.ledger import checkpoint
+from at2_node_tpu.ledger.accounts import Accounts
+from at2_node_tpu.ledger.recent import RecentTransactions
+from at2_node_tpu.node.config import CheckpointConfig, Config
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.types import ThinTransaction, TransactionState
+
+_ports = itertools.count(45500)
+
+
+class TestSnapshotRoundtrip:
+    @pytest.mark.asyncio
+    async def test_accounts_and_ring_roundtrip(self, tmp_path):
+        accounts, recent = Accounts(), RecentTransactions()
+        alice, bob = b"\x01" * 32, b"\x02" * 32
+        await accounts.transfer(alice, 1, bob, 500)
+        await recent.put(alice, 1, ThinTransaction(bob, 500))
+        await recent.update(alice, 1, TransactionState.SUCCESS)
+
+        path = str(tmp_path / "ledger.json")
+        await checkpoint.save(path, accounts, recent)
+
+        restored_a, restored_r = Accounts(), RecentTransactions()
+        assert await checkpoint.load(path, restored_a, restored_r) is True
+        assert await restored_a.get_balance(alice) == 99_500
+        assert await restored_a.get_balance(bob) == 100_500
+        assert await restored_a.get_last_sequence(alice) == 1
+        txs = await restored_r.get_all()
+        assert len(txs) == 1 and txs[0].state is TransactionState.SUCCESS
+        assert txs[0].amount == 500 and txs[0].sender == alice
+
+    @pytest.mark.asyncio
+    async def test_load_missing_is_fresh_start(self, tmp_path):
+        ok = await checkpoint.load(
+            str(tmp_path / "absent.json"), Accounts(), RecentTransactions()
+        )
+        assert ok is False
+
+    @pytest.mark.asyncio
+    async def test_corrupt_checkpoint_raises(self, tmp_path):
+        path = tmp_path / "bad.json"
+        path.write_text(json.dumps({"version": 99}))
+        with pytest.raises(ValueError):
+            await checkpoint.load(str(path), Accounts(), RecentTransactions())
+
+
+class TestNodeRestart:
+    @pytest.mark.asyncio
+    async def test_single_node_resumes_ledger_after_restart(self, tmp_path):
+        ckpt_path = str(tmp_path / "node.ckpt")
+
+        def make_config():
+            return Config(
+                node_address=f"127.0.0.1:{next(_ports)}",
+                rpc_address=f"127.0.0.1:{next(_ports)}",
+                sign_key=SignKeyPair.random(),
+                network_key=ExchangeKeyPair.random(),
+                checkpoint=CheckpointConfig(path=ckpt_path, interval=60.0),
+            )
+
+        sender, recipient = SignKeyPair.random(), SignKeyPair.random()
+
+        service = await Service.start(make_config())
+        try:
+            async with Client(f"http://{service.config.rpc_address}") as client:
+                await client.send_asset(sender, 1, recipient.public, 777)
+                deadline = asyncio.get_event_loop().time() + 10
+                while asyncio.get_event_loop().time() < deadline:
+                    if await client.get_last_sequence(sender.public) == 1:
+                        break
+                    await asyncio.sleep(0.1)
+                assert await client.get_balance(sender.public) == 99_223
+        finally:
+            await service.close()  # writes the final snapshot
+
+        # a NEW process-equivalent: fresh Service, same checkpoint path
+        service2 = await Service.start(make_config())
+        try:
+            async with Client(f"http://{service2.config.rpc_address}") as client:
+                assert await client.get_balance(sender.public) == 99_223
+                assert await client.get_balance(recipient.public) == 100_777
+                assert await client.get_last_sequence(sender.public) == 1
+                # the sequence gate carries over: replaying seq 1 must not
+                # double-apply, and seq 2 continues normally
+                await client.send_asset(sender, 2, recipient.public, 1)
+                deadline = asyncio.get_event_loop().time() + 10
+                while asyncio.get_event_loop().time() < deadline:
+                    if await client.get_last_sequence(sender.public) == 2:
+                        break
+                    await asyncio.sleep(0.1)
+                assert await client.get_balance(sender.public) == 99_222
+        finally:
+            await service2.close()
