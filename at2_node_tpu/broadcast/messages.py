@@ -71,16 +71,21 @@ class Payload:
 
     def content_hash(self) -> bytes:
         """Identifies the payload *content* within its slot — what Echo and
-        Ready votes attest to (sieve's equivocation unit)."""
-        return hashlib.sha256(
-            _PAYLOAD.pack(
-                self.sender,
-                self.sequence,
-                self.transaction.recipient,
-                self.transaction.amount,
-                self.signature,
-            )
-        ).digest()
+        Ready votes attest to (sieve's equivocation unit). Cached: the
+        broadcast pipeline consults it several times per message."""
+        cached = self.__dict__.get("_chash")
+        if cached is None:
+            cached = hashlib.sha256(
+                _PAYLOAD.pack(
+                    self.sender,
+                    self.sequence,
+                    self.transaction.recipient,
+                    self.transaction.amount,
+                    self.signature,
+                )
+            ).digest()
+            object.__setattr__(self, "_chash", cached)
+        return cached
 
     @staticmethod
     def decode_body(body: bytes) -> "Payload":
