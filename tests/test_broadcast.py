@@ -323,6 +323,64 @@ class TestStateMachine:
         await bcast.close()
 
     @pytest.mark.asyncio
+    async def test_equivocating_peer_votes_count_for_one_content_only(self):
+        # a byzantine PEER echoes two different contents for one slot; only
+        # its first verified vote may count (echo_by_origin), so neither
+        # content can assemble a quorum from one voter
+        bcast, mesh, peer_keys = make_net(2)
+        await start(bcast)
+        sender = SignKeyPair.random()
+        pay_a = make_payload(sender, amount=1)
+        pay_b = make_payload(sender, amount=2)
+        await bcast.broadcast(pay_a)
+        await bcast.broadcast(pay_b)
+        await settle(bcast)
+        # peer 0 equivocates: echoes BOTH contents; peer 1 echoes only A
+        await inject(bcast, echo_from(peer_keys[0], pay_a, ECHO))
+        await inject(bcast, echo_from(peer_keys[0], pay_b, ECHO))
+        await inject(bcast, echo_from(peer_keys[1], pay_a, ECHO))
+        await settle(bcast)
+        state = bcast._slots[pay_a.slot]
+        assert len(state.echoes[pay_a.content_hash()]) == 2
+        assert len(state.echoes[pay_b.content_hash()]) == 0  # vote discarded
+        await bcast.close()
+
+    @pytest.mark.asyncio
+    async def test_replayed_attestation_not_reverified(self):
+        # exact duplicate (same signature) is dropped by the dedup set
+        # BEFORE hitting the verifier (capacity protection)
+        bcast, mesh, peer_keys = make_net(2)
+        await start(bcast)
+        sender = SignKeyPair.random()
+        payload = make_payload(sender)
+        att = echo_from(peer_keys[0], payload, ECHO)
+        for _ in range(5):
+            await inject(bcast, att)
+        await settle(bcast)
+        verifier_calls = bcast.verifier.signatures_verified
+        assert verifier_calls == 1, f"verified {verifier_calls} times"
+        await bcast.close()
+
+    @pytest.mark.asyncio
+    async def test_delivered_slot_gossip_suppressed_after_compaction(self):
+        # once a slot is delivered and compacted, late gossip for it is
+        # dropped without re-creating state (memory bound after GC)
+        bcast, mesh, peer_keys = make_net(0)
+        await start(bcast)
+        sender = SignKeyPair.random()
+        payload = make_payload(sender)
+        await bcast.broadcast(payload)
+        await asyncio.wait_for(bcast.delivered.get(), 2)
+        # simulate GC compaction
+        bcast._delivered_slots.add(payload.slot)
+        del bcast._slots[payload.slot]
+        await inject(bcast, payload, peer=None)
+        await settle(bcast)
+        assert payload.slot not in bcast._slots
+        assert bcast.delivered.empty()
+        await bcast.close()
+
+    @pytest.mark.asyncio
     async def test_quorate_content_admitted_past_content_cap(self):
         # a byzantine equivocator fills the per-slot content cap with junk;
         # the content the honest quorum actually voted for must still be

@@ -84,6 +84,59 @@ async def test_pool_verifier_async():
 
 
 @pytest.mark.slow
+@pytest.mark.asyncio
+async def test_two_node_net_shares_one_pool_verifier():
+    """BASELINE config-5 shape at test scale: two full nodes inject all
+    their broadcast signature checks into ONE sharded pool
+    (Service.start(verifier=...)) and a transfer still commits."""
+    import itertools
+
+    from at2_node_tpu.client import Client
+    from at2_node_tpu.crypto.keys import ExchangeKeyPair
+    from at2_node_tpu.net.peers import Peer
+    from at2_node_tpu.node.config import Config
+    from at2_node_tpu.node.service import Service
+
+    ports = itertools.count(45800)
+    shared = pool.PoolVerifier(batch_size=64, max_delay=0.005)
+    await shared.warmup()
+    cfgs = [
+        Config(
+            node_address=f"127.0.0.1:{next(ports)}",
+            rpc_address=f"127.0.0.1:{next(ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+        )
+        for _ in range(2)
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.nodes = [
+            Peer(o.node_address, o.network_key.public, o.sign_key.public)
+            for j, o in enumerate(cfgs)
+            if j != i
+        ]
+    services = []
+    try:
+        for cfg in cfgs:
+            services.append(await Service.start(cfg, verifier=shared))
+        async with Client(f"http://{cfgs[0].rpc_address}") as client:
+            sender, recipient = SignKeyPair.random(), SignKeyPair.random()
+            await client.send_asset(sender, 1, recipient.public, 40)
+            deadline = asyncio.get_event_loop().time() + 30
+            while asyncio.get_event_loop().time() < deadline:
+                if await client.get_last_sequence(sender.public) == 1:
+                    break
+                await asyncio.sleep(0.1)
+            assert await client.get_balance(sender.public) == 99_960
+        assert shared.signatures_verified > 0
+        assert shared.batches_dispatched > 0
+    finally:
+        for s in services:
+            await s.close()
+        await shared.close()
+
+
+@pytest.mark.slow
 def test_make_verifier_pool_kind():
     from at2_node_tpu.crypto.verifier import make_verifier
 
