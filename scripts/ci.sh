@@ -35,6 +35,18 @@ echo "== fast tier =="
 python -m pytest tests/ -q -m "not slow"
 
 if [ "$tier" = "all" ]; then
+  echo "== native sanitizers (TSAN + ASAN) =="
+  # the reference gets race-freedom from Rust; the C++ prep library gets
+  # it from disjoint output ranges, proven under TSAN here (SURVEY §5)
+  (
+    cd at2_node_tpu/native
+    mkdir -p build
+    g++ -std=c++17 -O1 -g -fsanitize=thread at2_prep.cpp sanitize_test.cpp \
+        -o build/sanitize_tsan -lpthread && ./build/sanitize_tsan
+    g++ -std=c++17 -O1 -g -fsanitize=address at2_prep.cpp sanitize_test.cpp \
+        -o build/sanitize_asan -lpthread && ./build/sanitize_asan
+  )
+
   echo "== kernel tier (slow) =="
   python -m pytest tests/ -q -m "slow"
 fi
