@@ -181,7 +181,7 @@ def _guarded() -> None:
             env=env,
             capture_output=True,
             text=True,
-            timeout=2700,
+            timeout=1500,  # healthy cold-compile run fits in ~10 min
         )
         lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
         if proc.returncode == 0 and lines:
@@ -189,7 +189,7 @@ def _guarded() -> None:
             return
         error = f"bench child rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
     except subprocess.TimeoutExpired:
-        error = "bench child exceeded 2700s (device tunnel unreachable?)"
+        error = "bench child exceeded 1500s (device tunnel unreachable?)"
     print(
         json.dumps(
             {
