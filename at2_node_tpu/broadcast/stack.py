@@ -114,6 +114,9 @@ class _BoundedSet:
         if len(self._items) > self._cap:
             self._items.pop(next(iter(self._items)))
 
+    def discard(self, key) -> None:
+        self._items.pop(key, None)
+
     def __contains__(self, key) -> bool:
         return key in self._items
 
@@ -418,6 +421,12 @@ class Broadcast:
             len(state.contents) >= MAX_CONTENTS_PER_SLOT
             and not self._content_wanted(state, chash)
         ):
+            # Another worker filled the slot to the cap during the verify
+            # await. Un-poison the dedup set: _pre_gossip's NOTE promises
+            # cap rejections stay retryable, so a later retransmission (or
+            # the content-pull catch-up response, should this hash become
+            # the quorate one) must be processed, not dedup-suppressed.
+            self._gossip_seen.discard((slot, chash))
             return
         state.contents[chash] = payload
         # murmur: relay to everyone (gossip_size = full network)

@@ -52,13 +52,40 @@ def cmd_run(args: argparse.Namespace) -> int:
     config = Config.load(sys.stdin)
 
     async def main() -> None:
+        import signal
+
         from ..node.service import Service
 
         service = await Service.start(config)
+        # SIGTERM (systemd/k8s stop, test harness kill) must shut down
+        # gracefully like SIGINT: quiesce, drain deliveries, write the
+        # final checkpoint. Default SIGTERM disposition would kill the
+        # process mid-state with no snapshot.
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-unix / nested-loop fallback: SIGINT still works
+        serve = asyncio.ensure_future(service.serve_forever())
+        stopped = asyncio.ensure_future(stop.wait())
         try:
-            await service.serve_forever()
+            await asyncio.wait(
+                {serve, stopped}, return_when=asyncio.FIRST_COMPLETED
+            )
         finally:
+            # close() BEFORE cancelling serve: wait_for_termination shares
+            # grpc's shutdown future — cancelling it first poisons the
+            # stop() await inside close() with CancelledError.
             await service.close()
+            for t in (serve, stopped):
+                t.cancel()
+            await asyncio.gather(serve, stopped, return_exceptions=True)
+        if not stop.is_set() and serve.done() and not serve.cancelled():
+            exc = serve.exception()
+            if exc is not None:
+                raise exc  # server crashed: surface it, exit nonzero
 
     try:
         asyncio.run(main())

@@ -104,12 +104,37 @@ class CpuVerifier:
         self._pool.shutdown(wait=False, cancel_futures=True)
 
 
+class _ChunkSink:
+    """Result collector shared by every signature of one enqueued chunk:
+    ONE asyncio future per chunk (the broadcast worker's verify_many slice),
+    not one per signature — the per-message future/gather overhead was the
+    TPU path's residual event-loop cost (round-2 advisor finding)."""
+
+    __slots__ = ("future", "results", "remaining")
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, n: int) -> None:
+        self.future: asyncio.Future = loop.create_future()
+        self.results: List[bool] = [False] * n
+        self.remaining = n
+
+    def set(self, idx: int, ok: bool) -> None:
+        self.results[idx] = ok
+        self.remaining -= 1
+        if self.remaining == 0 and not self.future.done():
+            self.future.set_result(self.results)
+
+    def fail(self, exc: BaseException) -> None:
+        if not self.future.done():
+            self.future.set_exception(exc)
+
+
 @dataclass
 class _Pending:
     public_key: bytes
     message: bytes
     signature: bytes
-    future: asyncio.Future
+    sink: _ChunkSink
+    idx: int  # this signature's slot in sink.results
     enqueued_at: float
 
 
@@ -139,8 +164,12 @@ class TpuBatchVerifier:
         # Backpressure bound: callers await queue room instead of growing
         # the accumulator without limit (the broadcast worker pool already
         # self-limits; this protects against unbounded verify_many floods).
+        # Capacity is a counted reservation (condition variable, bulk
+        # acquire/release) so verify_many reserves a whole chunk in one
+        # await instead of one semaphore acquire per signature.
         self.max_queue = max(8 * batch_size, 4096)
-        self._capacity = asyncio.Semaphore(self.max_queue)
+        self._cap_free = self.max_queue
+        self._cap_cond = asyncio.Condition()
         self._wakeup = asyncio.Event()
         self._device_pool = ThreadPoolExecutor(max_workers=1)
         self._closed = False
@@ -177,30 +206,68 @@ class TpuBatchVerifier:
                 return b
         return self.buckets[-1]
 
+    async def _acquire(self, n: int) -> None:
+        """Reserve queue room for ``n`` signatures in one await."""
+        async with self._cap_cond:
+            while self._cap_free < n and not self._closed:
+                await self._cap_cond.wait()
+            if self._closed:
+                raise RuntimeError("verifier closed")
+            self._cap_free -= n
+
+    async def _release(self, n: int) -> None:
+        async with self._cap_cond:
+            self._cap_free += n
+            self._cap_cond.notify_all()
+
+    def _enqueue_chunk(self, items, sink: _ChunkSink) -> None:
+        was_empty = not self._queue
+        now = time.monotonic()
+        append = self._queue.append
+        for idx, (pk, msg, sig) in enumerate(items):
+            append(_Pending(pk, msg, sig, sink, idx, now))
+        # Wake the flusher on the empty->non-empty transition too, so a lone
+        # request waits max_delay, not the flusher's 100ms idle-poll tick.
+        if was_empty or len(self._queue) >= self.batch_size:
+            self._wakeup.set()
+
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         if self._closed:
             raise RuntimeError("verifier closed")
-        await self._capacity.acquire()
-        if self._closed:
-            # re-release so wake-ups cascade to every parked caller
-            self._capacity.release()
-            raise RuntimeError("verifier closed")
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.append(
-            _Pending(public_key, message, signature, fut, time.monotonic())
-        )
-        # Wake the flusher on the empty->non-empty transition too, so a lone
-        # request waits max_delay, not the flusher's 100ms idle-poll tick.
-        if len(self._queue) == 1 or len(self._queue) >= self.batch_size:
-            self._wakeup.set()
-        return await fut
+        await self._acquire(1)
+        sink = _ChunkSink(asyncio.get_running_loop(), 1)
+        self._enqueue_chunk(((public_key, message, signature),), sink)
+        return (await sink.future)[0]
 
     async def verify_many(
         self, items: Sequence[Tuple[bytes, bytes, bytes]]
     ) -> List[bool]:
-        return list(
-            await asyncio.gather(*(self.verify(pk, m, s) for pk, m, s in items))
-        )
+        """Bulk path: the whole chunk enters the accumulator under ONE
+        capacity reservation and resolves through ONE future per
+        batch_size slice (slices larger than a batch could never flush as
+        one dispatch anyway, so slicing there costs nothing)."""
+        if self._closed:
+            raise RuntimeError("verifier closed")
+        n = len(items)
+        if n == 0:
+            return []
+        loop = asyncio.get_running_loop()
+        sinks: List[_ChunkSink] = []
+        items = list(items) if not isinstance(items, (list, tuple)) else items
+        for i in range(0, n, self.batch_size):
+            chunk = items[i : i + self.batch_size]
+            await self._acquire(len(chunk))
+            sink = _ChunkSink(loop, len(chunk))
+            self._enqueue_chunk(chunk, sink)
+            sinks.append(sink)
+        # gather (not sequential awaits): when an early chunk's dispatch
+        # fails, every sink's exception is still retrieved — no
+        # "exception was never retrieved" spam for the later chunks
+        chunk_results = await asyncio.gather(*(s.future for s in sinks))
+        out: List[bool] = []
+        for results in chunk_results:
+            out.extend(results)
+        return out
 
     async def _flush_loop(self) -> None:
         while not self._closed:
@@ -232,8 +299,7 @@ class TpuBatchVerifier:
                 self._queue[: self.batch_size],
                 self._queue[self.batch_size :],
             )
-            for _ in batch:
-                self._capacity.release()
+            await self._release(len(batch))
             await self._dispatch(batch)
 
     def _run_batch(self, pks, msgs, sigs, bucket) -> np.ndarray:
@@ -284,10 +350,18 @@ class TpuBatchVerifier:
         t0 = time.monotonic()
         try:
             results = await loop.run_in_executor(self._device_pool, run)
-        except Exception as exc:
+        except BaseException as exc:
+            # BaseException: a close() mid-dispatch cancels the flusher
+            # while this batch is already popped from _queue — its sinks
+            # MUST still resolve or their verify_many callers hang forever
             for p in batch:
-                if not p.future.done():
-                    p.future.set_exception(exc)
+                p.sink.fail(
+                    RuntimeError("verifier closed")
+                    if isinstance(exc, asyncio.CancelledError)
+                    else exc
+                )
+            if isinstance(exc, asyncio.CancelledError):
+                raise
             return
         self.last_dispatch_s = time.monotonic() - t0
         self.total_dispatch_s += self.last_dispatch_s
@@ -295,8 +369,7 @@ class TpuBatchVerifier:
         self.signatures_verified += len(batch)
         self.total_padding += bucket - len(batch)
         for p, ok in zip(batch, results):
-            if not p.future.done():
-                p.future.set_result(bool(ok))
+            p.sink.set(p.idx, bool(ok))
 
     async def close(self) -> None:
         self._closed = True
@@ -307,14 +380,13 @@ class TpuBatchVerifier:
         except (asyncio.CancelledError, Exception):
             pass
         for p in self._queue:
-            if not p.future.done():
-                p.future.set_exception(RuntimeError("verifier closed"))
-            self._capacity.release()
+            p.sink.fail(RuntimeError("verifier closed"))
+        released = len(self._queue)
         self._queue.clear()
-        # unblock any callers parked on the capacity semaphore; they re-check
-        # _closed after acquire and raise
-        for _ in range(self.max_queue):
-            self._capacity.release()
+        # return the dead queue's capacity and wake every caller parked in
+        # _acquire (they re-check _closed under the condition and raise —
+        # the notify matters even when released == 0)
+        await self._release(released)
         self._device_pool.shutdown(wait=False, cancel_futures=True)
 
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import logging
+import time
 from typing import Dict, Optional, Tuple
 
 import grpc
@@ -41,6 +42,14 @@ _TRAILER_FRAME = 0x80
 
 _MAX_BODY = 4 * 1024 * 1024
 _MAX_HEADER = 64 * 1024
+# Splice-path bounds: each spliced native-gRPC connection costs two pump
+# tasks, so the count is capped and fully-idle splices are reaped. Idle
+# means NO traffic in EITHER direction for the whole window (a watchdog
+# checks a shared last-activity stamp), so a long-running RPC whose
+# client half is quiet — e.g. a SendAsset parked behind a saturated
+# broadcast inbox — is never torn down while the server is replying.
+_MAX_SPLICES = 256
+_SPLICE_IDLE = 300.0
 
 # method name -> request message class (the service's reply types come
 # back from the servicer call itself)
@@ -117,6 +126,7 @@ class PortMux:
         self.servicer = servicer
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set = set()  # live per-connection handler tasks
+        self._n_splices = 0  # live spliced native-gRPC connections
 
     async def start(self) -> None:
         host, _, port = self.listen_addr.rpartition(":")
@@ -197,30 +207,60 @@ class PortMux:
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
     ) -> None:
-        """Bidirectional byte pipe to the internal grpc.aio port."""
-        up_reader, up_writer = await asyncio.open_connection(
-            self.grpc_host, self.grpc_port
-        )
-        up_writer.write(head)
+        """Bidirectional byte pipe to the internal grpc.aio port, bounded
+        in count (cap) and lifetime (per-read idle timeout) so an
+        idle-splice flood cannot pin pump tasks indefinitely."""
+        if self._n_splices >= _MAX_SPLICES:
+            logger.warning("splice cap reached (%d); rejecting", _MAX_SPLICES)
+            writer.close()
+            return
+        self._n_splices += 1
+        try:
+            up_reader, up_writer = await asyncio.open_connection(
+                self.grpc_host, self.grpc_port
+            )
+            up_writer.write(head)
+            last_activity = time.monotonic()
 
-        async def pipe(src: asyncio.StreamReader, dst: asyncio.StreamWriter):
-            try:
-                while True:
-                    chunk = await src.read(65536)
-                    if not chunk:
-                        break
-                    dst.write(chunk)
-                    await dst.drain()
-            finally:
+            async def pipe(src: asyncio.StreamReader, dst: asyncio.StreamWriter):
+                # bare read loop: the idle policy lives in the watchdog, so
+                # the data plane pays no per-chunk timer machinery
+                nonlocal last_activity
                 try:
-                    dst.close()
-                except Exception:
-                    pass
+                    while True:
+                        chunk = await src.read(65536)
+                        if not chunk:
+                            break
+                        last_activity = time.monotonic()
+                        dst.write(chunk)
+                        await dst.drain()
+                finally:
+                    try:
+                        dst.close()
+                    except Exception:
+                        pass
 
-        await asyncio.gather(
-            pipe(reader, up_writer), pipe(up_reader, writer),
-            return_exceptions=True,
-        )
+            async def watchdog():
+                while True:
+                    await asyncio.sleep(_SPLICE_IDLE / 4)
+                    if time.monotonic() - last_activity > _SPLICE_IDLE:
+                        for w in (writer, up_writer):
+                            try:
+                                w.close()  # pumps wake with EOF and exit
+                            except Exception:
+                                pass
+                        return
+
+            wd = asyncio.create_task(watchdog())
+            try:
+                await asyncio.gather(
+                    pipe(reader, up_writer), pipe(up_reader, writer),
+                    return_exceptions=True,
+                )
+            finally:
+                wd.cancel()
+        finally:
+            self._n_splices -= 1
 
     # -- HTTP/1 grpc-web --------------------------------------------------
 
@@ -260,7 +300,16 @@ class PortMux:
             await self._respond(writer, "405 Method Not Allowed", "text/plain", b"")
             return
 
-        length = int(headers.get("content-length", "0"))
+        try:
+            length = int(headers.get("content-length", "0"))
+        except ValueError:
+            length = -1
+        if length < 0:
+            # malformed/negative Content-Length answers 400 instead of
+            # falling into the generic handler (which would log a full
+            # traceback per junk request on the public port)
+            await self._respond(writer, "400 Bad Request", "text/plain", b"")
+            return
         if length > _MAX_BODY:
             await self._respond(writer, "413 Payload Too Large", "text/plain", b"")
             return

@@ -123,62 +123,73 @@ class Service(At2Servicer):
                 if service._owns_verifier:
                     await service.verifier.close()
                 raise
-        service.mesh = Mesh(
-            config.node_address,
-            config.network_key,
-            config.nodes,
-            on_frame=lambda peer, frame: service.broadcast.on_frame(peer, frame),
-        )
-        service.broadcast = Broadcast(
-            config.sign_key,
-            service.mesh,
-            service.verifier,
-            echo_threshold=config.echo_threshold,
-            ready_threshold=config.ready_threshold,
-        )
-        await service.mesh.start()
-        await service.broadcast.start()
-        service._delivery_task = asyncio.create_task(service._delivery_loop())
-
-        # interval <= 0 means snapshot-on-shutdown only (consistent with
-        # the observability convention where 0 disables the periodic task)
-        if config.checkpoint.path and config.checkpoint.interval > 0:
-            service._checkpoint_task = asyncio.create_task(
-                service._checkpoint_loop(
-                    config.checkpoint.path, config.checkpoint.interval
-                )
-            )
-
-        obs = config.observability
-        if obs.stats_interval > 0:
-            _enable_stats_logging()
-            service._stats_task = asyncio.create_task(
-                service._stats_loop(obs.stats_interval)
-            )
-        if obs.profile_dir:
-            import jax
-
-            jax.profiler.start_trace(obs.profile_dir)
-            service._profiling = True
-
-        # The public RPC port is a mux (reference parity: tonic serves
-        # native gRPC AND grpc-web/HTTP1/CORS on one port, main.rs:110-114):
-        # grpc.aio binds an internal loopback port; the mux splices HTTP/2
-        # clients to it and answers grpc-web itself.
-        server = grpc.aio.server()
-        add_to_server(service, server)
-        internal_port = server.add_insecure_port("127.0.0.1:0")
-        if internal_port == 0:
-            await service.close()
-            raise OSError("cannot bind internal grpc port")
-        await server.start()
-        service._grpc_server = server
-        service._mux = PortMux(config.rpc_address, internal_port, service)
+        # Everything past the verifier is brought up under one guard:
+        # close() tolerates partially-initialized state, so ANY bring-up
+        # failure (mesh bind, broadcast start, profiler, grpc/mux bind)
+        # releases the warmed-up verifier, mesh tasks, and background
+        # loops instead of leaking them.
         try:
-            await service._mux.start()
-        except OSError:
+            service.mesh = Mesh(
+                config.node_address,
+                config.network_key,
+                config.nodes,
+                on_frame=lambda peer, frame: service.broadcast.on_frame(peer, frame),
+            )
+            service.broadcast = Broadcast(
+                config.sign_key,
+                service.mesh,
+                service.verifier,
+                echo_threshold=config.echo_threshold,
+                ready_threshold=config.ready_threshold,
+            )
+            await service.mesh.start()
+            await service.broadcast.start()
+            service._delivery_task = asyncio.create_task(service._delivery_loop())
+
+            # interval <= 0 means snapshot-on-shutdown only (consistent with
+            # the observability convention where 0 disables the periodic task)
+            if config.checkpoint.path and config.checkpoint.interval > 0:
+                service._checkpoint_task = asyncio.create_task(
+                    service._checkpoint_loop(
+                        config.checkpoint.path, config.checkpoint.interval
+                    )
+                )
+
+            obs = config.observability
+            if obs.stats_interval > 0:
+                _enable_stats_logging()
+                service._stats_task = asyncio.create_task(
+                    service._stats_loop(obs.stats_interval)
+                )
+            if obs.profile_dir:
+                import jax
+
+                jax.profiler.start_trace(obs.profile_dir)
+                service._profiling = True
+
+            # The public RPC port is a mux (reference parity: tonic serves
+            # native gRPC AND grpc-web/HTTP1/CORS on one port, main.rs:110-114):
+            # grpc.aio binds an internal loopback port; the mux splices HTTP/2
+            # clients to it and answers grpc-web itself.
+            server = grpc.aio.server()
+            add_to_server(service, server)
+            # assigned BEFORE start: if start() (or anything after) raises,
+            # the guard's close() must stop this server, not leak its port
+            service._grpc_server = server
+            internal_port = server.add_insecure_port("127.0.0.1:0")
+            if internal_port == 0:
+                raise OSError("cannot bind internal grpc port")
+            await server.start()
+            service._mux = PortMux(config.rpc_address, internal_port, service)
+            try:
+                await service._mux.start()
+            except OSError as exc:
+                raise OSError(
+                    f"cannot bind rpc address {config.rpc_address}"
+                ) from exc
+        except BaseException:
             await service.close()
-            raise OSError(f"cannot bind rpc address {config.rpc_address}")
+            raise
         logger.info(
             "node up: mesh on %s, rpc on %s, %d peers, verifier=%s",
             config.node_address,
@@ -212,7 +223,12 @@ class Service(At2Servicer):
         if self._mux is not None:
             await self._mux.close()
         if self._grpc_server is not None:
-            await self._grpc_server.stop(grace=0.5)
+            try:
+                await self._grpc_server.stop(grace=0.5)
+            except Exception:
+                # stop() on a server whose start() never completed (failed
+                # bring-up path) can raise; the socket dies with the object
+                logger.exception("grpc server stop failed")
         if self._delivery_task is not None:
             self._delivery_task.cancel()
             try:
@@ -225,6 +241,23 @@ class Service(At2Servicer):
             await self.mesh.close()
         if self.verifier is not None and self._owns_verifier:
             await self.verifier.close()
+        # Graceful-shutdown drain: payloads still sitting in
+        # broadcast.delivered or the retry heap were already delivered
+        # NETWORK-WIDE (peers commit and compact them — nothing will ever
+        # re-gossip them to us). Dropping them here would permanently
+        # desync this node's per-account sequence gate after restart, so
+        # commit them before the final snapshot. Crash shutdown remains
+        # best-effort by design (ledger/checkpoint.py docstring).
+        if self.broadcast is not None:
+            now = time.monotonic()
+            while True:
+                try:
+                    p = self.broadcast.delivered.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                self._push_pending(p, now)
+        if self._heap:
+            await self._drain_to_fixpoint()
         # Final snapshot LAST — ingress, delivery, and broadcast are all
         # stopped, so no commit can land after (and be missing from) it.
         if self.config.checkpoint.path:
@@ -275,6 +308,14 @@ class Service(At2Servicer):
 
     # -- delivery → commit loop ------------------------------------------
 
+    def _push_pending(self, p: Payload, now: float) -> None:
+        """Push one delivered payload onto the retry heap — the ONE place
+        the heap key is built (delivery loop and shutdown drain share it:
+        the commit order must not depend on which path enqueued)."""
+        key = (p.sequence, p.sender, p.transaction.recipient, p.transaction.amount)
+        self._push_count += 1
+        heapq.heappush(self._heap, (key, now, self._push_count, p))
+
     async def _delivery_loop(self) -> None:
         queue = self.broadcast.delivered
         while True:
@@ -287,9 +328,7 @@ class Service(At2Servicer):
                     break
             now = time.monotonic()
             for p in batch:
-                key = (p.sequence, p.sender, p.transaction.recipient, p.transaction.amount)
-                self._push_count += 1
-                heapq.heappush(self._heap, (key, now, self._push_count, p))
+                self._push_pending(p, now)
             await self._drain_to_fixpoint()
 
     async def _drain_to_fixpoint(self) -> None:
