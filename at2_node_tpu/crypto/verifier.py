@@ -299,8 +299,24 @@ class TpuBatchVerifier:
                 self._queue[: self.batch_size],
                 self._queue[self.batch_size :],
             )
-            await self._release(len(batch))
-            await self._dispatch(batch)
+            try:
+                await self._release(len(batch))
+                await self._dispatch(batch)
+            except BaseException as exc:
+                # once popped from _queue, close()'s sweep can no longer
+                # see this batch — a cancellation landing in the _release
+                # await (or anywhere before dispatch resolves the sinks)
+                # must fail them here or their callers hang forever
+                for p in batch:
+                    p.sink.fail(
+                        RuntimeError("verifier closed")
+                        if isinstance(exc, asyncio.CancelledError)
+                        else exc
+                    )
+                if isinstance(exc, asyncio.CancelledError):
+                    raise  # close() is tearing the flusher down
+                # anything else: this batch already failed its callers;
+                # the flusher itself stays up for subsequent batches
 
     def _run_batch(self, pks, msgs, sigs, bucket) -> np.ndarray:
         """One device dispatch; subclasses (e.g. parallel.pool.PoolVerifier)
