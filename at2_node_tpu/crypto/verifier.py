@@ -65,7 +65,14 @@ class CpuVerifier:
         return {"signatures": self.signatures_verified}
 
     async def warmup(self) -> None:
-        pass  # nothing to compile
+        """Build/load the native ingest library off the event loop NOW:
+        its first-use g++ compile (up to tens of seconds) must never run
+        lazily inside a live worker chunk and freeze the node."""
+        from ..native import ingest_available
+
+        await asyncio.get_running_loop().run_in_executor(
+            self._pool, ingest_available
+        )
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         loop = asyncio.get_running_loop()
@@ -77,14 +84,36 @@ class CpuVerifier:
     async def verify_many(
         self, items: Sequence[Tuple[bytes, bytes, bytes]]
     ) -> List[bool]:
-        """Bulk path: one executor round-trip per WORKER SLICE, not per
-        signature — the per-call submit/wakeup machinery costs as much as
-        the OpenSSL verify itself for small messages (round-2 profile)."""
+        """Bulk path: ONE executor round-trip and (when the native ingest
+        library built) ONE C call for the whole chunk — OpenSSL grinds on
+        native threads with the GIL released, fanned out across real
+        cores C++-side instead of GIL-juggled Python slices. Falls back
+        to per-slice Python verification (round-2 shape) otherwise."""
         loop = asyncio.get_running_loop()
         self.signatures_verified += len(items)
         n = len(items)
         if n == 0:
             return []
+
+        from ..native import ingest_available, verify_bulk_native
+
+        # The one-C-call path has fixed staging cost (ragged ndarray
+        # packing, ctypes crossing) that only amortizes on real batches;
+        # trickle-sized chunks stay on the slice path (measured on the
+        # 4-node e2e config: the native call is a wash below ~32 items
+        # and LOSES below ~16).
+        if n >= 32 and ingest_available():
+            # thread fan-out capped at the REAL core count: executor
+            # max_workers is an IO-sizing default (cpu+4) and oversubscribing
+            # OpenSSL threads on small hosts costs more than it buys
+            import os
+
+            n_threads = max(1, min(self._max_workers, os.cpu_count() or 1))
+            result = await loop.run_in_executor(
+                self._pool, verify_bulk_native, items, n_threads
+            )
+            return result.tolist()
+
         slices = min(n, self._max_workers)
         step = (n + slices - 1) // slices
 

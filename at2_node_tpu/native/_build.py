@@ -1,0 +1,83 @@
+"""Shared build/load/packing helpers for the native libraries.
+
+Both ctypes bindings (`prep.py`, `ingest.py`) compile their translation
+unit with the system g++ on first use into ``build/`` (cached by source
+mtime, per-process temp names so concurrent first-use builds in separate
+processes never promote each other's half-written output) and pack
+ragged byte sequences into (flat, offsets) ndarray pairs for the C ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+U8P = ctypes.POINTER(ctypes.c_uint8)
+U32P = ctypes.POINTER(ctypes.c_uint32)
+U64P = ctypes.POINTER(ctypes.c_uint64)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+BUILD_DIR = os.path.join(_HERE, "build")
+
+
+def build_lib(
+    src_name: str, lib_name: str, extra_args: Sequence[str] = ()
+) -> Optional[str]:
+    """Compile ``src_name`` into ``build/lib_name`` unless cached-fresh.
+    Returns the library path or None when the toolchain/link deps are
+    missing (callers fall back to their Python paths)."""
+    src = os.path.join(_HERE, src_name)
+    lib_path = os.path.join(BUILD_DIR, lib_name)
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        src, *extra_args, "-o", tmp,
+    ]
+    try:
+        os.makedirs(BUILD_DIR, exist_ok=True)
+        if os.path.exists(lib_path) and os.path.getmtime(
+            lib_path
+        ) >= os.path.getmtime(src):
+            return lib_path
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib_path)
+        return lib_path
+    except Exception as exc:  # missing g++/libs, read-only tree
+        logger.warning("native build of %s failed (%s)", src_name, exc)
+        return None
+
+
+def load_lib(
+    src_name: str, lib_name: str, extra_args: Sequence[str] = ()
+) -> Optional[ctypes.CDLL]:
+    path = build_lib(src_name, lib_name, extra_args)
+    if path is None:
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError as exc:
+        logger.warning("native load of %s failed (%s)", lib_name, exc)
+        return None
+
+
+def pack_ragged(chunks: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten byte chunks into (flat u8 array, u64 offsets) for the C ABI."""
+    offsets = np.zeros(len(chunks) + 1, dtype=np.uint64)
+    np.cumsum([len(c) for c in chunks], out=offsets[1:])
+    flat = (
+        np.frombuffer(b"".join(chunks), dtype=np.uint8)
+        if chunks
+        else np.zeros(0, np.uint8)
+    )
+    return flat, offsets
+
+
+def ptr8(a: np.ndarray):
+    return a.ctypes.data_as(U8P)

@@ -1,0 +1,150 @@
+"""ctypes bindings for the native message-plane ingest (at2_ingest.cpp).
+
+Same build-on-first-use pattern as `prep.py` (shared helpers in
+`_build.py`); additionally links the system libcrypto (OpenSSL 3) for
+the bulk ed25519 verify, so on images without it the build fails cleanly
+and callers fall back to Python.
+
+Exports:
+* :func:`parse_frames_native` — one C call parses a whole chunk of wire
+  frames (kind dispatch + record extraction + payload SHA-256 content
+  hashes) and returns the same message objects `parse_frame` would, with
+  the content hash pre-seeded so the state machine never re-hashes.
+* :func:`verify_bulk_native` — one C call verifies a whole list of
+  (pk, msg, sig) items on native threads; verdicts bit-identical with
+  `crypto.keys.verify_one` (same libcrypto under both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..broadcast.messages import (
+    ECHO,
+    GOSSIP,
+    READY,
+    REQUEST,
+    Attestation,
+    ContentRequest,
+    Payload,
+)
+from ._build import U8P, U32P, U64P, load_lib, pack_ragged, ptr8
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_LINK_ARGS = ("-l:libcrypto.so.3",)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        lib = load_lib("at2_ingest.cpp", "libat2ingest.so", _LINK_ARGS)
+        if lib is None:
+            return None
+        lib.at2_parse_frames.argtypes = [
+            U8P, U64P, ctypes.c_int64, U8P, ctypes.c_int64, U32P, U8P,
+        ]
+        lib.at2_parse_frames.restype = ctypes.c_int64
+        lib.at2_verify_bulk.argtypes = [
+            U8P, U64P, U8P, U64P, U8P, U64P,
+            ctypes.c_int64, ctypes.c_int64, U8P,
+        ]
+        lib.at2_verify_bulk.restype = None
+        lib.at2_ingest_row_stride.argtypes = []
+        lib.at2_ingest_row_stride.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def ingest_available() -> bool:
+    if os.environ.get("AT2_NO_NATIVE_INGEST"):
+        return False  # explicit kill-switch (benchmarking / incident triage)
+    return _load() is not None
+
+
+def parse_frames_native(frames: Sequence[bytes]):
+    """Parse many frames in one native call.
+
+    Returns ``(messages, frame_ok)`` where messages is a list of
+    ``(frame_index, message_object)`` and ``frame_ok[i]`` says whether
+    frame i parsed cleanly (malformed frames are dropped whole, matching
+    ``parse_frame``'s WireError behavior)."""
+    lib = _load()
+    assert lib is not None, "call ingest_available() first"
+    flat, offsets = pack_ragged(frames)
+    stride = int(lib.at2_ingest_row_stride())
+    cap = int(flat.size // 69) + len(frames) + 1
+    rows = np.zeros((cap, stride), dtype=np.uint8)
+    msg_frame = np.zeros(cap, dtype=np.uint32)
+    frame_ok = np.zeros(len(frames), dtype=np.uint8)
+    n = int(
+        lib.at2_parse_frames(
+            ptr8(flat),
+            offsets.ctypes.data_as(U64P),
+            len(frames),
+            ptr8(rows),
+            cap,
+            msg_frame.ctypes.data_as(U32P),
+            ptr8(frame_ok),
+        )
+    )
+    assert n >= 0, "row capacity underestimated"  # cap bounds total msgs
+
+    # Object building reuses the same Struct-based decode_body paths the
+    # Python parser uses (one C-level unpack per message); the native
+    # side's contribution is the GIL-released validation pass and the
+    # payload content hashes (seeded below so nothing re-hashes later).
+    out: List[tuple] = []
+    row_bytes = rows[:n].tobytes()
+    frame_idx = msg_frame[:n].tolist()
+    setattr_ = object.__setattr__
+    for i in range(n):
+        base = i * stride
+        kind = row_bytes[base]
+        if kind == GOSSIP:
+            msg = Payload.decode_body(row_bytes[base + 1 : base + 141])
+            setattr_(msg, "_chash", row_bytes[base + 141 : base + 173])
+        elif kind in (ECHO, READY):
+            msg = Attestation.decode_body(
+                kind, row_bytes[base + 1 : base + 165]
+            )
+        elif kind == REQUEST:
+            msg = ContentRequest.decode_body(row_bytes[base + 1 : base + 69])
+        else:  # pragma: no cover - the C side never emits other kinds
+            continue
+        out.append((frame_idx[i], msg))
+    return out, frame_ok.astype(bool)
+
+
+def verify_bulk_native(
+    items: Sequence[Tuple[bytes, bytes, bytes]], n_threads: int = 1
+) -> np.ndarray:
+    """Verify (public_key, message, signature) items in one native call.
+    The GIL is released for the whole call (ctypes), so the event loop
+    breathes while OpenSSL grinds; n_threads > 1 fans out on real cores."""
+    lib = _load()
+    assert lib is not None, "call ingest_available() first"
+    n = len(items)
+    out = np.zeros(n, dtype=np.uint8)
+    if n == 0:
+        return out.astype(bool)
+    pk_flat, pk_off = pack_ragged([it[0] for it in items])
+    msg_flat, msg_off = pack_ragged([it[1] for it in items])
+    sig_flat, sig_off = pack_ragged([it[2] for it in items])
+    lib.at2_verify_bulk(
+        ptr8(pk_flat), pk_off.ctypes.data_as(U64P),
+        ptr8(msg_flat), msg_off.ctypes.data_as(U64P),
+        ptr8(sig_flat), sig_off.ctypes.data_as(U64P),
+        n, n_threads, ptr8(out),
+    )
+    return out.astype(bool)

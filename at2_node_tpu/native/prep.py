@@ -11,45 +11,23 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-logger = logging.getLogger(__name__)
+from ._build import U8P, U64P, load_lib
+from ._build import pack_ragged as _pack
+from ._build import ptr8 as _ptr8
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "at2_prep.cpp")
-_BUILD_DIR = os.path.join(_HERE, "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libat2prep.so")
+logger = logging.getLogger(__name__)
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
-_U8P = ctypes.POINTER(ctypes.c_uint8)
-_U64P = ctypes.POINTER(ctypes.c_uint64)
-
-
-def _build() -> Optional[str]:
-    # per-process temp name: concurrent first-use builds in separate
-    # processes must not promote each other's half-written output
-    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", tmp,
-    ]
-    try:
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
-            return _LIB_PATH
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB_PATH)
-        return _LIB_PATH
-    except Exception as exc:  # missing g++, read-only tree, missing source
-        logger.warning("native prep build failed (%s); using python path", exc)
-        return None
+_U8P = U8P
+_U64P = U64P
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -58,13 +36,8 @@ def _load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        path = _build()
-        if path is None:
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError as exc:
-            logger.warning("native prep load failed (%s)", exc)
+        lib = load_lib("at2_prep.cpp", "libat2prep.so")
+        if lib is None:
             return None
         lib.at2_prep_batch.argtypes = [
             _U8P, _U64P, _U8P, _U64P, _U8P, _U64P,
@@ -82,17 +55,6 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _load() is not None
-
-
-def _pack(chunks: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
-    offsets = np.zeros(len(chunks) + 1, dtype=np.uint64)
-    np.cumsum([len(c) for c in chunks], out=offsets[1:])
-    flat = np.frombuffer(b"".join(chunks), dtype=np.uint8) if chunks else np.zeros(0, np.uint8)
-    return flat, offsets
-
-
-def _ptr8(a: np.ndarray):
-    return a.ctypes.data_as(_U8P)
 
 
 def prep_batch_native(

@@ -45,6 +45,12 @@ if [ "$tier" = "all" ]; then
         -o build/sanitize_tsan -lpthread && ./build/sanitize_tsan
     g++ -std=c++17 -O1 -g -fsanitize=address at2_prep.cpp sanitize_test.cpp \
         -o build/sanitize_asan -lpthread && ./build/sanitize_asan
+    g++ -std=c++17 -O1 -g -fsanitize=thread at2_ingest.cpp \
+        sanitize_ingest_test.cpp -o build/sanitize_ingest_tsan \
+        -lpthread -l:libcrypto.so.3 && ./build/sanitize_ingest_tsan
+    g++ -std=c++17 -O1 -g -fsanitize=address at2_ingest.cpp \
+        sanitize_ingest_test.cpp -o build/sanitize_ingest_asan \
+        -lpthread -l:libcrypto.so.3 && ./build/sanitize_ingest_asan
   )
 
   echo "== kernel tier (slow) =="
