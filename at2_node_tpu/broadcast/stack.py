@@ -205,6 +205,13 @@ class Broadcast:
         }
 
     async def start(self) -> None:
+        # Pre-build the native ingest library off-loop HERE — broadcast is
+        # its consumer, so this covers every verifier configuration (the
+        # lazy first-use g++ compile must never run on the event loop
+        # inside a live worker chunk and freeze the node).
+        from ..native import ingest_available
+
+        await asyncio.get_running_loop().run_in_executor(None, ingest_available)
         for _ in range(self.workers):
             self._tasks.append(asyncio.create_task(self._worker()))
         self._tasks.append(asyncio.create_task(self._gc_loop()))

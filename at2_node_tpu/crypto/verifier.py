@@ -65,9 +65,9 @@ class CpuVerifier:
         return {"signatures": self.signatures_verified}
 
     async def warmup(self) -> None:
-        """Build/load the native ingest library off the event loop NOW:
-        its first-use g++ compile (up to tens of seconds) must never run
-        lazily inside a live worker chunk and freeze the node."""
+        """Build/load the native ingest library off the event loop (its
+        bulk-verify path uses it; Broadcast.start covers the parse path
+        for every verifier configuration)."""
         from ..native import ingest_available
 
         await asyncio.get_running_loop().run_in_executor(

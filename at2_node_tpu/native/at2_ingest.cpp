@@ -251,7 +251,8 @@ void at2_verify_bulk(const uint8_t* pk_flat, const uint64_t* pk_off,
   for (auto& th : threads) th.join();
 }
 
-// Row-stride export so the Python binding never hardcodes the layout.
+// Layout exports so the Python binding never hardcodes them.
 int64_t at2_ingest_row_stride(void) { return int64_t(kRowStride); }
+int64_t at2_ingest_min_wire(void) { return int64_t(kMinWire); }
 
 }  // extern "C"

@@ -62,6 +62,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.at2_verify_bulk.restype = None
         lib.at2_ingest_row_stride.argtypes = []
         lib.at2_ingest_row_stride.restype = ctypes.c_int64
+        lib.at2_ingest_min_wire.argtypes = []
+        lib.at2_ingest_min_wire.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -83,7 +85,8 @@ def parse_frames_native(frames: Sequence[bytes]):
     assert lib is not None, "call ingest_available() first"
     flat, offsets = pack_ragged(frames)
     stride = int(lib.at2_ingest_row_stride())
-    cap = int(flat.size // 69) + len(frames) + 1
+    # messages are >= min_wire bytes, so this cap bounds the row count
+    cap = int(flat.size // int(lib.at2_ingest_min_wire())) + len(frames) + 1
     rows = np.zeros((cap, stride), dtype=np.uint8)
     msg_frame = np.zeros(cap, dtype=np.uint32)
     frame_ok = np.zeros(len(frames), dtype=np.uint8)
@@ -98,7 +101,8 @@ def parse_frames_native(frames: Sequence[bytes]):
             ptr8(frame_ok),
         )
     )
-    assert n >= 0, "row capacity underestimated"  # cap bounds total msgs
+    if n < 0:  # cannot happen given the bound; survive `python -O` anyway
+        raise RuntimeError("native parse overflowed its row capacity")
 
     # Object building reuses the same Struct-based decode_body paths the
     # Python parser uses (one C-level unpack per message); the native

@@ -9,10 +9,9 @@ fail (no compiler, read-only tree); callers must check
 from __future__ import annotations
 
 import ctypes
-import logging
 import os
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -20,14 +19,9 @@ from ._build import U8P, U64P, load_lib
 from ._build import pack_ragged as _pack
 from ._build import ptr8 as _ptr8
 
-logger = logging.getLogger(__name__)
-
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
-
-_U8P = U8P
-_U64P = U64P
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -40,14 +34,14 @@ def _load() -> Optional[ctypes.CDLL]:
         if lib is None:
             return None
         lib.at2_prep_batch.argtypes = [
-            _U8P, _U64P, _U8P, _U64P, _U8P, _U64P,
+            U8P, U64P, U8P, U64P, U8P, U64P,
             ctypes.c_int64, ctypes.c_int64,
-            _U8P, _U8P, _U8P, _U8P, _U8P,
+            U8P, U8P, U8P, U8P, U8P,
         ]
         lib.at2_prep_batch.restype = None
-        lib.at2_sha512.argtypes = [_U8P, ctypes.c_int64, _U8P]
+        lib.at2_sha512.argtypes = [U8P, ctypes.c_int64, U8P]
         lib.at2_sha512.restype = None
-        lib.at2_mod_l.argtypes = [_U8P, _U8P]
+        lib.at2_mod_l.argtypes = [U8P, U8P]
         lib.at2_mod_l.restype = None
         _lib = lib
         return _lib
@@ -82,9 +76,9 @@ def prep_batch_native(
     if n_threads <= 0:
         n_threads = os.cpu_count() or 1
     lib.at2_prep_batch(
-        _ptr8(pk_flat), pk_off.ctypes.data_as(_U64P),
-        _ptr8(msg_flat), msg_off.ctypes.data_as(_U64P),
-        _ptr8(sig_flat), sig_off.ctypes.data_as(_U64P),
+        _ptr8(pk_flat), pk_off.ctypes.data_as(U64P),
+        _ptr8(msg_flat), msg_off.ctypes.data_as(U64P),
+        _ptr8(sig_flat), sig_off.ctypes.data_as(U64P),
         n, n_threads,
         _ptr8(a), _ptr8(r), _ptr8(s), _ptr8(h), _ptr8(valid8),
     )

@@ -1,0 +1,337 @@
+"""Fault-injection tier: the recovery paths under real failures.
+
+The reference leaves recovery as roadmap items ("TODO readd connections
+if dropped", `/root/reference/src/bin/server/rpc.rs:87`; TTL drop at
+`rpc.rs:35`; "catchup mechanism", `/root/reference/README.md:53`); this
+build implements them, and these tests pin the implementations under
+REAL faults on real localhost nets:
+
+* kill one node of a 3-node net under traffic, restart it, assert the
+  peers' redial/backoff loop re-converges the net (net/peers.py
+  `_outbound_loop`);
+* the deliberately-kept TTL quirk: a payload that outlives
+  TRANSACTION_TTL is recorded Failure yet still processes and can flip
+  to Success (node/service.py `_drain_to_fixpoint`, mirroring the
+  reference's missing `continue`, rpc.rs:183-205);
+* a partition that loses a payload's gossip entirely: the node still
+  reaches Ready quorum via attestations and pulls the content from the
+  quorum (broadcast/stack.py `_request_content` — exercised here over
+  real sockets, not state-machine calls);
+* SIGKILL a CLI server mid-commit-stream with [checkpoint] enabled,
+  restart it, assert no double-apply (per-account sequence gate) and
+  re-convergence for new traffic.
+"""
+
+import asyncio
+import itertools
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from at2_node_tpu.broadcast.messages import Payload, parse_frame
+from at2_node_tpu.client import Client
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.net.peers import Peer
+from at2_node_tpu.node.config import CheckpointConfig, Config
+from at2_node_tpu.node.service import Service
+
+TICK = 0.1
+TIMEOUT = 15.0
+
+_ports = itertools.count(46200)
+
+FAUCET = 100_000
+
+
+def make_configs(n, **kwargs):
+    cfgs = [
+        Config(
+            node_address=f"127.0.0.1:{next(_ports)}",
+            rpc_address=f"127.0.0.1:{next(_ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+            **kwargs,
+        )
+        for _ in range(n)
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.nodes = [
+            Peer(o.node_address, o.network_key.public, o.sign_key.public)
+            for j, o in enumerate(cfgs)
+            if j != i
+        ]
+    return cfgs
+
+
+async def wait_until(pred, timeout=TIMEOUT, what="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if await pred():
+            return
+        await asyncio.sleep(TICK)
+    raise TimeoutError(f"{what} not reached within {timeout}s")
+
+
+class TestKillRestartRedial:
+    @pytest.mark.asyncio
+    async def test_node_killed_and_restarted_reconverges(self):
+        # f=1-tolerant thresholds: with one node down the other two can
+        # still commit (default reference thresholds are n_peers, which
+        # has zero fault tolerance — the knobs exist for exactly this)
+        cfgs = make_configs(3, echo_threshold=1, ready_threshold=1)
+        services = [await Service.start(c) for c in cfgs]
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset(sender, 1, recipient, 10)
+                await wait_until(
+                    lambda: _committed_on(services, 1, sender.public),
+                    what="tx1 on all nodes",
+                )
+
+                # kill node 2 (connections drop; peers enter redial)
+                await services[2].close()
+
+                # the surviving majority keeps committing under traffic
+                await client.send_asset(sender, 2, recipient, 10)
+                await wait_until(
+                    lambda: _committed_on(services[:2], 2, sender.public),
+                    what="tx2 on survivors",
+                )
+
+                # restart node 2 on the same addresses; peers redial it
+                services[2] = await Service.start(cfgs[2])
+                await client.send_asset(sender, 3, recipient, 10)
+                await wait_until(
+                    lambda: _committed_on(
+                        [services[0], services[1], services[2]],
+                        3,
+                        sender.public,
+                        # the restarted node missed seq 1-2 entirely, so
+                        # its gate holds tx3 in the retry heap; what it
+                        # MUST show is the tx arriving over the redialed
+                        # connections (delivery), not the commit
+                        delivered_only=[2],
+                    ),
+                    what="tx3 after restart",
+                )
+            # the restarted node's broadcast saw tx3 via redialed links
+            assert services[2].broadcast.stats["delivered"] >= 1
+        finally:
+            for s in services:
+                await s.close()
+
+
+async def _committed_on(services, seq, sender_pub, delivered_only=()):
+    for i, s in enumerate(services):
+        if i in delivered_only:
+            if s.broadcast.stats["delivered"] < 1:
+                return False
+        elif await s.accounts.get_last_sequence(sender_pub) < seq:
+            return False
+    return True
+
+
+class TestTtlQuirk:
+    @pytest.mark.asyncio
+    async def test_expired_payload_marked_failure_then_flips_success(
+        self, monkeypatch
+    ):
+        from at2_node_tpu.node import service as service_mod
+
+        monkeypatch.setattr(service_mod, "TRANSACTION_TTL", 0.3)
+        cfg = make_configs(1)[0]
+        svc = await Service.start(cfg)
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfg.rpc_address}") as client:
+                # seq 2 first: gap-blocked, parks in the retry heap
+                await client.send_asset(sender, 2, recipient, 10)
+                await asyncio.sleep(0.5)  # outlive the 0.3s TTL
+
+                # a second gapped payload triggers a drain pass that must
+                # record the expired seq-2 as FAILURE (and still retry it)
+                await client.send_asset(sender, 3, recipient, 10)
+
+                async def seq2_failed():
+                    txs = await client.get_latest_transactions()
+                    return any(
+                        t.sender_sequence == 2 and t.state.name == "FAILURE"
+                        for t in txs
+                    )
+
+                await wait_until(seq2_failed, what="seq2 FAILURE record")
+
+                # gap-filling seq 1 lets the EXPIRED payloads commit: the
+                # reference quirk — no `continue` after the TTL branch —
+                # means expiry does not drop them
+                await client.send_asset(sender, 1, recipient, 10)
+
+                async def all_success():
+                    if await client.get_last_sequence(sender.public) != 3:
+                        return False
+                    txs = await client.get_latest_transactions()
+                    states = {
+                        t.sender_sequence: t.state.name
+                        for t in txs
+                        if t.sender == sender.public
+                    }
+                    return states == {1: "SUCCESS", 2: "SUCCESS", 3: "SUCCESS"}
+
+                await wait_until(all_success, what="expired payloads flip to SUCCESS")
+                assert await client.get_balance(sender.public) == FAUCET - 30
+        finally:
+            await svc.close()
+
+
+class TestPartitionHealContentPull:
+    @pytest.mark.asyncio
+    async def test_lost_gossip_recovered_via_content_request(self):
+        # Thresholds such that Echo/Ready quorums can form WITHOUT the
+        # starved node's echo (it has no content, so it cannot echo):
+        # with the reference's defaults (= all peers) a single lost
+        # gossip stalls the slot net-wide — the exact fragility the
+        # pull-based catch-up exists to break out of. The victim still
+        # needs a full Ready quorum (2) before it pulls.
+        cfgs = make_configs(3, echo_threshold=1, ready_threshold=2)
+        services = [await Service.start(c) for c in cfgs]
+        victim = services[2]
+
+        # fault injection at the wire boundary: strip the first two
+        # Payload copies addressed to node 2 (the gossip relays from each
+        # peer), let everything else — echoes, readies, and the later
+        # content-pull response — through untouched
+        dropped = 0
+        original = victim.mesh.on_frame
+
+        async def lossy(peer, frame):
+            nonlocal dropped
+            msgs = parse_frame(frame)
+            kept = []
+            for m in msgs:
+                if isinstance(m, Payload) and dropped < 2:
+                    dropped += 1
+                    continue
+                kept.append(m)
+            if kept:
+                await original(peer, b"".join(m.encode() for m in kept))
+
+        victim.mesh.on_frame = lossy
+
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset(sender, 1, recipient, 25)
+
+                async def all_committed():
+                    for s in services:
+                        if await s.accounts.get_last_sequence(sender.public) < 1:
+                            return False
+                    return True
+
+                await wait_until(
+                    all_committed, what="commit on the gossip-starved node"
+                )
+            assert dropped == 2, "the fault never actually fired"
+            # the victim pulled the content after observing the quorum
+            assert victim.broadcast.stats["content_req_tx"] >= 1
+            served = sum(
+                s.broadcast.stats["content_served"] for s in services[:2]
+            )
+            assert served >= 1
+            assert await victim.accounts.get_balance(recipient) == FAUCET + 25
+        finally:
+            for s in services:
+                await s.close()
+
+
+class TestCrashConsistency:
+    def _spawn_server(self, toml: str, log):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "at2_node_tpu.cli.server", "run"],
+            stdin=subprocess.PIPE,
+            stdout=log,
+            stderr=log,
+            text=True,
+        )
+        proc.stdin.write(toml)
+        proc.stdin.close()
+        return proc
+
+    @pytest.mark.asyncio
+    async def test_sigkill_midstream_restart_no_double_apply(self, tmp_path):
+        """kill -9 (not a graceful stop): restart must not double-apply
+        what the snapshot already holds, and the node must serve and
+        commit new traffic afterwards."""
+        cfg = make_configs(1)[0]
+        cfg.checkpoint = CheckpointConfig(
+            path=str(tmp_path / "ledger.ckpt"), interval=0.2
+        )
+        toml = cfg.dumps()
+        log = open(tmp_path / "server.log", "w")
+        proc = self._spawn_server(toml, log)
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfg.rpc_address}") as client:
+                await wait_until(
+                    lambda: _rpc_up(client, sender.public), what="server up"
+                )
+                # commit a stream, give the periodic snapshot a beat
+                for seq in range(1, 6):
+                    await client.send_asset(sender, seq, recipient, 10)
+                await wait_until(
+                    lambda: _seq_is(client, sender.public, 5),
+                    what="pre-kill commits",
+                )
+                await asyncio.sleep(0.5)  # >= 2 checkpoint intervals
+
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=5)
+
+            proc = self._spawn_server(toml, log)
+            async with Client(f"http://{cfg.rpc_address}") as client:
+                await wait_until(
+                    lambda: _rpc_up(client, sender.public), what="restarted"
+                )
+                # no double-apply: balances/sequence match the committed
+                # stream exactly (the snapshot held them; replays would
+                # break the sequence gate or inflate balances)
+                assert await client.get_last_sequence(sender.public) == 5
+                assert await client.get_balance(sender.public) == FAUCET - 50
+                assert await client.get_balance(recipient) == FAUCET + 50
+
+                # and the node still commits new traffic
+                await client.send_asset(sender, 6, recipient, 10)
+                await wait_until(
+                    lambda: _seq_is(client, sender.public, 6),
+                    what="post-restart commit",
+                )
+                assert await client.get_balance(recipient) == FAUCET + 60
+        finally:
+            log.close()
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+async def _rpc_up(client, user) -> bool:
+    try:
+        await asyncio.wait_for(client.get_balance(user), timeout=1.0)
+        return True
+    except Exception:
+        return False
+
+
+async def _seq_is(client, user, seq) -> bool:
+    return await client.get_last_sequence(user) == seq
