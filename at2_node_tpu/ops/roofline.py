@@ -1,0 +1,96 @@
+"""Roofline model for the batched ed25519 verify kernel.
+
+Answers the "actually fast, or just correct?" question for the one
+component this project exists for (BASELINE north star): given the
+kernel's own structural constants, how many int32 VPU operations does
+one signature verification cost, and what fraction of a v5e chip's
+vector throughput does the measured device-only rate represent?
+
+The per-signature work is pure int32 VPU arithmetic (the MXU plays no
+part: 13-bit-limb modular convolutions are element-wise multiply-adds,
+not dense matmuls) and the memory traffic is trivial — 129 bytes in and
+1 byte out per signature puts the kernel ~4 orders of magnitude from
+the HBM roofline, so the VPU ceiling is the only one that matters.
+
+Counting rules (deliberately charitable to the hardware, i.e. the
+roofline_pct this model reports is a LOWER bound on true utilization):
+
+* one ``f_mul`` = the 20x20 schoolbook convolution (400 int32 muls +
+  ~400 shifted adds) + 2 carry rounds + top fold + weak reduce
+  (~200 ops) ~= 1000 int32 ops;
+* point ops in f_mul units: unified double = 4M+4S = 8, complete
+  a=-1 add = 9 (8M + the 2d constant mul);
+* the Straus table lookups are NOT free: a one-hot masked sum over 16
+  window entries x 4 coords x 20 limbs = 1280 mul-adds + the one-hot
+  compare (~16) per lookup;
+* per-signature structure (ops/pallas_verify.py, ops/edwards.py):
+  2 decompressions (sqrt chain _pow_t250: 250 squarings + ~13 muls,
+  plus ~8 muls of x-recovery/sign fixup each), one 15-add window-table
+  build for A, 64 Straus windows x (4 doubles + 2 adds + 2 lookups),
+  and the final affine equality (one inversion chain ~= 254 + ~6).
+
+Reference cites: the kernel replaces the per-message CPU verification
+inside the reference's broadcast crates (/root/reference/technical.md:7-12).
+"""
+
+from __future__ import annotations
+
+from . import field as fe
+
+# ---- per-f_mul int32 op cost (see counting rules above) -------------
+CONV_MULS = fe.N_LIMBS * fe.N_LIMBS  # 400
+CONV_ADDS = fe.N_LIMBS * fe.N_LIMBS  # shifted-row accumulation
+REDUCE_OPS = 200  # 2 carry rounds + fold + weak reduce, ~10 ops/limb
+OPS_PER_FMUL = CONV_MULS + CONV_ADDS + REDUCE_OPS  # ~1000
+
+# ---- per-signature structure, in f_mul units ------------------------
+N_WINDOWS = 64
+DBL_FMUL = 8  # 4M + 4S
+ADD_FMUL = 9  # 8M + 2d-constant mul
+SQRT_CHAIN_FMUL = 250 + 13  # _pow_t250: squarings + chain muls
+DECOMPRESS_FMUL = SQRT_CHAIN_FMUL + 8  # + x-recovery, sign fixup
+TABLE_BUILD_FMUL = 15 * ADD_FMUL
+STRAUS_FMUL = N_WINDOWS * (4 * DBL_FMUL + 2 * ADD_FMUL)  # 3200
+INVERT_FMUL = 254 + 6  # final affine equality's inversion chain
+
+FMUL_PER_SIG = (
+    2 * DECOMPRESS_FMUL + TABLE_BUILD_FMUL + STRAUS_FMUL + INVERT_FMUL
+)
+
+# ---- lookup cost (not f_mul-shaped, counted directly) ---------------
+LOOKUPS_PER_SIG = N_WINDOWS * 2
+OPS_PER_LOOKUP = 16 * 4 * fe.N_LIMBS + 16  # one-hot masked sum + compare
+
+INT32_OPS_PER_SIG = (
+    FMUL_PER_SIG * OPS_PER_FMUL + LOOKUPS_PER_SIG * OPS_PER_LOOKUP
+)
+
+# ---- v5e ceilings (public figures) ----------------------------------
+# VPU: 4 vector units x (8, 128) lanes x ~940 MHz, one int32 op per
+# lane-cycle ~= 3.85e12 int32 ops/s. HBM: 819 GB/s.
+V5E_VPU_INT32_OPS = 4 * 8 * 128 * 0.94e9
+V5E_HBM_BYTES = 819e9
+
+BYTES_PER_SIG = 129 + 1  # packed row in, verdict byte out
+
+
+def model(device_only_sigs_per_sec: float) -> dict:
+    """Roofline summary for a measured device-only verify rate."""
+    achieved_ops = device_only_sigs_per_sec * INT32_OPS_PER_SIG
+    vpu_bound_rate = V5E_VPU_INT32_OPS / INT32_OPS_PER_SIG
+    hbm_bound_rate = V5E_HBM_BYTES / BYTES_PER_SIG
+    return {
+        # the ceilings below assume THIS chip generation; a bench run on
+        # a different TPU must not quote them as its own roofline
+        "chip_model": "v5e",
+        "fmul_per_sig": FMUL_PER_SIG,
+        "int32_ops_per_sig": INT32_OPS_PER_SIG,
+        "achieved_int32_tops": round(achieved_ops / 1e12, 3),
+        "vpu_peak_int32_tops": round(V5E_VPU_INT32_OPS / 1e12, 3),
+        "roofline_pct": round(100.0 * achieved_ops / V5E_VPU_INT32_OPS, 1),
+        "vpu_bound_sigs_per_sec": round(vpu_bound_rate, 0),
+        "hbm_bound_sigs_per_sec": round(hbm_bound_rate, 0),
+        "compute_vs_memory_bound_ratio": round(
+            hbm_bound_rate / vpu_bound_rate, 0
+        ),
+    }

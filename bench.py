@@ -153,6 +153,17 @@ def child_main() -> None:
         out = run_packed(dev_in)
         assert bool(np.asarray(out)[:bucket].all()), "warm-up failed to verify"
 
+        # profiler capture of the device-only shape, headline bucket only
+        # (trace path lands in the artifact — VERDICT r2 item 7)
+        trace_dir = ""
+        if bucket == HEADLINE_BUCKET and str(dev.platform) == "tpu":
+            trace_dir = os.path.join(_REPO, ".profile_traces", f"bench_b{bucket}")
+            try:
+                with jax.profiler.trace(trace_dir):
+                    np.asarray(run_packed(dev_in))
+            except Exception as exc:  # tunnel-backed profiler may refuse
+                trace_dir = f"unavailable: {exc}"
+
         best_device, best_pipe = 0.0, 0.0
         for _ in range(TRIALS):
             # 1) device-only ceiling (inputs resident, one final sync)
@@ -190,17 +201,15 @@ def child_main() -> None:
             # consume the dangling prep future so it cannot steal CPU from
             # the next trial's timed sections
             next_prep.result()
-        print(
-            json.dumps(
-                {
-                    "bucket": bucket,
-                    "device_only": round(best_device, 1),
-                    "pipelined": round(best_pipe, 1),
-                    "device": str(dev.platform),
-                }
-            ),
-            flush=True,
-        )
+        line = {
+            "bucket": bucket,
+            "device_only": round(best_device, 1),
+            "pipelined": round(best_pipe, 1),
+            "device": str(dev.platform),
+        }
+        if trace_dir:
+            line["trace_dir"] = trace_dir
+        print(json.dumps(line), flush=True)
 
     # host prep rate (one thread) + CPU (OpenSSL) per-sig baseline
     pks, msgs, sigs = _make_batch(8192)
@@ -412,6 +421,18 @@ def orchestrate() -> None:
         },
         "device_only_rate": headline["device_only"],
     }
+    if "trace_dir" in headline:
+        result["trace_dir"] = headline["trace_dir"]
+    # roofline: is the device-only rate 10% or 60% of the chip's vector
+    # ceiling? (static op-count model derived from the kernel's own
+    # constants — at2_node_tpu/ops/roofline.py documents the counting)
+    if device == "tpu":
+        try:
+            from at2_node_tpu.ops.roofline import model as roofline_model
+
+            result["roofline"] = roofline_model(headline["device_only"])
+        except Exception as exc:  # never silently lose the promised block
+            result["roofline"] = {"error": str(exc)[:200]}
     for k in ("host_prep_rate", "cpu_openssl_1core_rate"):
         if k in aux:
             result[k] = aux[k]
