@@ -201,7 +201,22 @@ class PoolVerifier(TpuBatchVerifier):
         # single bucket == single compiled program (see TpuBatchVerifier)
         super().__init__(batch_size=batch_size, max_delay=max_delay)
 
-    def _run_batch(self, pks, msgs, sigs, bucket):
-        return verify_batch_sharded(
-            pks, msgs, sigs, mesh=self.mesh, batch_size=bucket
-        )
+    # staged pipeline overrides (the base class overlaps these stages
+    # across consecutive batches; see TpuBatchVerifier._dispatch)
+
+    def _prep(self, pks, msgs, sigs, bucket):
+        q = _pool_quantum(self.mesh.devices.size)
+        if bucket % q != 0:
+            raise ValueError(
+                f"bucket {bucket} not divisible by pool quantum {q}"
+            )
+        return kernel.prepare_batch(pks, msgs, sigs, bucket)
+
+    def _launch(self, prepared):
+        fn = _pallas_fn(self.mesh) if _pallas_on_mesh() else _verify_fn(self.mesh)
+        out = fn(*(jnp.asarray(x) for x in prepared))
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass
+        return out

@@ -66,3 +66,89 @@ def test_make_verifier():
             make_verifier("gpu")
 
     asyncio.run(run())
+
+
+def test_dispatch_pipeline_overlaps_batches():
+    """Consecutive batches must overlap across the prep/launch/finish
+    stages. Structural assertion (not wall-clock, which is flaky on a
+    loaded single-core host): some batch's prep must START before an
+    earlier batch's finish has ENDED."""
+    import time as _time
+
+    import numpy as _np
+
+    async def run():
+        events = []  # (stage, "start"/"end", batch_tag, t)
+
+        class SlowStages(TpuBatchVerifier):
+            def _prep(self, pks, msgs, sigs, bucket):
+                tag = len(events)
+                events.append(("prep", "start", tag, _time.monotonic()))
+                _time.sleep(0.02)
+                events.append(("prep", "end", tag, _time.monotonic()))
+                return len(pks)
+
+            def _launch(self, prepared):
+                return prepared
+
+            def _finish(self, handle, n):
+                events.append(("finish", "start", None, _time.monotonic()))
+                _time.sleep(0.05)
+                events.append(("finish", "end", None, _time.monotonic()))
+                return _np.ones(n, dtype=bool)
+
+        ver = SlowStages(batch_size=4, max_delay=0.001)
+        items = [(b"p" * 32, b"m", b"s" * 64)] * 32  # 8 batches of 4
+        out = await ver.verify_many(items)
+        assert out == [True] * 32
+        assert ver.batches_dispatched == 8
+        prep_starts = sorted(
+            t for s, k, _, t in events if s == "prep" and k == "start"
+        )
+        finish_ends = sorted(
+            t for s, k, _, t in events if s == "finish" and k == "end"
+        )
+        # pipelined: the LAST prep begins while finishes are still
+        # outstanding (serial execution would order every finish-end
+        # before the next prep-start)
+        assert prep_starts[-1] < finish_ends[-1], "stages never overlapped"
+        overlapping = sum(
+            1 for t in prep_starts if t < finish_ends[0]
+        )
+        assert overlapping >= 2, f"only {overlapping} preps before first finish end"
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_close_with_inflight_completions_resolves_everything():
+    """close() while batches sit between launch and finish must resolve
+    every caller (success or 'verifier closed'), never hang."""
+    import time as _time
+
+    import numpy as _np
+
+    async def run():
+        class SlowFinish(TpuBatchVerifier):
+            def _prep(self, pks, msgs, sigs, bucket):
+                return len(pks)
+
+            def _launch(self, prepared):
+                return prepared
+
+            def _finish(self, handle, n):
+                _time.sleep(0.15)
+                return _np.ones(n, dtype=bool)
+
+        ver = SlowFinish(batch_size=4, max_delay=0.001)
+        futs = [
+            asyncio.ensure_future(ver.verify(b"p" * 32, b"m", b"s" * 64))
+            for _ in range(8)
+        ]
+        await asyncio.sleep(0.05)  # let at least one batch pass launch
+        await asyncio.wait_for(ver.close(), timeout=5)
+        results = await asyncio.gather(*futs, return_exceptions=True)
+        for r in results:
+            assert r is True or isinstance(r, RuntimeError), r
+
+    asyncio.run(run())
