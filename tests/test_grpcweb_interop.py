@@ -219,7 +219,7 @@ class TestHttp1EdgeCases:
             writer.write(
                 b"POST /at2.AT2/GetBalance HTTP/1.1\r\n"
                 b"Host: x\r\nContent-Type: application/grpc-web+proto\r\n"
-                b"Expect: 100-continue\r\n"
+                b"Expect: 100-continue\r\nConnection: close\r\n"
                 + f"Content-Length: {len(frame)}\r\n\r\n".encode()
             )
             await writer.drain()
@@ -281,7 +281,7 @@ class TestPinnedTranscripts:
         reader, writer = await asyncio.open_connection(host, int(port))
         writer.write(raw)
         await writer.drain()
-        resp = await asyncio.wait_for(reader.read(), timeout=10)
+        resp = await asyncio.wait_for(_read_response(reader), timeout=10)
         writer.close()
         head, _, body = resp.partition(b"\r\n\r\n")
         assert status in head.split(b"\r\n")[0], head[:100]
@@ -289,3 +289,85 @@ class TestPinnedTranscripts:
             if b"grpc-web-text" in head:
                 body = base64.b64decode(body)
             assert _parse_balance(body) == FAUCET
+
+
+async def _read_response(reader) -> bytes:
+    """Read exactly one HTTP response (headers + Content-Length body);
+    the server keeps connections alive, so EOF never delimits."""
+    data = b""
+    while b"\r\n\r\n" not in data:
+        chunk = await reader.read(4096)
+        if not chunk:
+            return data
+        data += chunk
+    head, _, rest = data.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1])
+    while len(rest) < length:
+        chunk = await reader.read(4096)
+        if not chunk:
+            break
+        rest += chunk
+    return head + b"\r\n\r\n" + rest[:length]
+
+
+class TestKeepAlive:
+    @pytest.mark.asyncio
+    async def test_two_calls_one_connection(self):
+        """HTTP/1.1 keep-alive: a stock client's second unary call rides
+        the SAME connection (tonic parity; previously every call paid a
+        reconnect)."""
+        async with node() as cfg:
+            host, _, port = cfg.rpc_address.rpartition(":")
+            frame = _request_frame()
+            req = (
+                b"POST /at2.AT2/GetBalance HTTP/1.1\r\n"
+                b"Host: x\r\nContent-Type: application/grpc-web+proto\r\n"
+                + f"Content-Length: {len(frame)}\r\n\r\n".encode()
+                + frame
+            )
+            reader, writer = await asyncio.open_connection(host, int(port))
+            for _ in range(2):
+                writer.write(req)
+                await writer.drain()
+                resp = await asyncio.wait_for(_read_response(reader), timeout=10)
+                head, _, body = resp.partition(b"\r\n\r\n")
+                assert b"200 OK" in head.split(b"\r\n")[0]
+                assert b"connection: keep-alive" in head.lower()
+                assert _parse_balance(body) == FAUCET
+            writer.close()
+
+    @pytest.mark.asyncio
+    async def test_requests_session_reuses_connection(self):
+        """urllib3 session pooling works end-to-end against the mux —
+        asserted by the SERVER's accepted-connection counter, so a
+        regression to close-per-response (which urllib3 would silently
+        absorb by reconnecting) fails the test."""
+        import requests
+
+        ctx = node()
+        async with ctx as cfg:
+
+            def calls():
+                with requests.Session() as s:
+                    out = []
+                    for _ in range(3):
+                        r = s.post(
+                            _url(cfg),
+                            data=_request_frame(),
+                            headers={
+                                "Content-Type": "application/grpc-web+proto"
+                            },
+                            timeout=10,
+                        )
+                        out.append((r.status_code, _parse_balance(r.content)))
+                    return out
+
+            results = await asyncio.get_event_loop().run_in_executor(None, calls)
+            assert results == [(200, FAUCET)] * 3
+            assert ctx.svc._mux._http1_accepted == 1, (
+                f"expected one reused connection, server accepted "
+                f"{ctx.svc._mux._http1_accepted}"
+            )

@@ -87,7 +87,8 @@ class TestGrpcWeb:
             req = (
                 "OPTIONS /at2.AT2/SendAsset HTTP/1.1\r\nHost: node\r\n"
                 "Origin: http://example.com\r\n"
-                "Access-Control-Request-Method: POST\r\n\r\n"
+                "Access-Control-Request-Method: POST\r\n"
+                "Connection: close\r\n\r\n"
             ).encode()
             status_line, headers, _ = await _http1(cfg.rpc_address, req)
             assert "204" in status_line
