@@ -91,6 +91,23 @@ class Mesh:
         self._tasks: list = []
         self._channels: set = set()  # live channels, closed on shutdown
         self._closed = False
+        # observability counters (SURVEY.md §5): connection churn and
+        # best-effort-plane drops are the operator's failure-detection
+        # signals
+        self.redials = 0  # established connections dropped + re-dialed
+        self.dial_failures = 0  # connect/handshake attempts that failed
+        self.send_overflows = 0
+
+    def stats(self) -> dict:
+        return {
+            "channels": len(self._channels),
+            "send_queue_depth": sum(
+                q.qsize() for q in self._send_queues.values()
+            ),
+            "redials": self.redials,
+            "dial_failures": self.dial_failures,
+            "send_overflows": self.send_overflows,
+        }
 
     async def start(self) -> None:
         host, _, port = self.listen_addr.rpartition(":")
@@ -130,6 +147,7 @@ class Mesh:
             except asyncio.QueueFull:
                 try:  # drop the oldest queued frame and retry
                     q.get_nowait()
+                    self.send_overflows += 1
                     logger.warning("send queue overflow to %s", peer.address)
                 except asyncio.QueueEmpty:
                     pass
@@ -151,6 +169,7 @@ class Mesh:
             try:
                 channel = await transport.connect(host, port, self.keypair)
             except (OSError, transport.HandshakeError, asyncio.TimeoutError):
+                self.dial_failures += 1
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
                 continue
@@ -160,6 +179,7 @@ class Mesh:
                     peer.address,
                     channel.peer_public.hex(),
                 )
+                self.dial_failures += 1
                 channel.close()
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
@@ -191,6 +211,7 @@ class Mesh:
                     await channel.send(b"".join(pending))
                     pending = None
             except (transport.ChannelClosed, ConnectionError):
+                self.redials += 1
                 logger.warning("connection to %s dropped; redialing", peer.address)
             finally:
                 channel.close()

@@ -141,6 +141,13 @@ class PortMux:
         self._n_http1 = 0  # live keep-alive HTTP/1 connections
         self._http1_accepted = 0  # total accepted (observability/tests)
 
+    def stats(self) -> dict:
+        return {
+            "splices": self._n_splices,
+            "http1_conns": self._n_http1,
+            "http1_accepted": self._http1_accepted,
+        }
+
     async def start(self) -> None:
         host, _, port = self.listen_addr.rpartition(":")
         self._server = await asyncio.start_server(

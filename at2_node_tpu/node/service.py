@@ -292,6 +292,10 @@ class Service(At2Servicer):
                 out.update(
                     {f"verifier_{k}": v for k, v in verifier_stats().items()}
                 )
+        if self.mesh is not None:
+            out.update({f"mesh_{k}": v for k, v in self.mesh.stats().items()})
+        if self._mux is not None:
+            out.update({f"rpc_{k}": v for k, v in self._mux.stats().items()})
         return out
 
     async def _stats_loop(self, interval: float) -> None:

@@ -269,6 +269,16 @@ class TestObservability:
                 assert snap["committed"] == 1
                 assert snap["delivered"] == 1
                 assert snap["verifier_signatures"] >= 1
+                # transport-plane counters (mesh + rpc mux) ride along
+                assert snap["mesh_redials"] == 0
+                assert snap["mesh_send_overflows"] == 0
+                assert "mesh_channels" in snap and "mesh_send_queue_depth" in snap
+                # this test's client is native gRPC (spliced), so the
+                # HTTP/1 counter must be exactly zero — catching both a
+                # phantom increment and a missing key
+                assert snap["rpc_http1_accepted"] == 0
+                assert snap["mesh_dial_failures"] == 0
+                assert "rpc_splices" in snap
                 stats_lines = [
                     r.message for r in caplog.records if "committed=" in r.message
                 ]
