@@ -176,11 +176,11 @@ def _check_safety(per_node_deliveries, honest_sigs):
 
 
 @pytest.mark.parametrize("seed", [1, 7, 23, 51])
-def test_totality_and_consistency_lossless_schedules(seed):
+async def test_totality_and_consistency_lossless_schedules(seed):
     """Dup + arbitrary reordering, no loss: every node must deliver every
-    honest slot exactly once, with network-wide agreement."""
-
-    async def run():
+    honest slot exactly once, with network-wide agreement. async-def so
+    conftest's hang watchdog (with task-stack dumps) covers a wedge."""
+    if True:
         rng = random.Random(seed)
         net = AdversarialNet(4, rng, dup=0.25, drop=0.0)
         await net.start()
@@ -206,16 +206,13 @@ def test_totality_and_consistency_lossless_schedules(seed):
         finally:
             await net.close()
 
-    asyncio.run(run())
-
 
 @pytest.mark.parametrize("seed", [3, 13, 37, 91])
-def test_consistency_under_loss_and_equivocation(seed):
+async def test_consistency_under_loss_and_equivocation(seed):
     """Random loss + a byzantine client equivocating two contents for the
     SAME slot: totality is forfeit (loss), but consistency and validity
     must survive every schedule."""
-
-    async def run():
+    if True:
         rng = random.Random(seed)
         # default thresholds (= all peers): echo quorums must intersect, so
         # consistency is a real guarantee of this config — threshold 2 of
@@ -244,5 +241,3 @@ def test_consistency_under_loss_and_equivocation(seed):
             )
         finally:
             await net.close()
-
-    asyncio.run(run())
