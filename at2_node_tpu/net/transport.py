@@ -36,6 +36,7 @@ import os
 import struct
 from dataclasses import dataclass, field
 
+from cryptography.exceptions import InvalidTag
 from cryptography.hazmat.primitives import hashes
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.hazmat.primitives.kdf.hkdf import HKDF
@@ -106,13 +107,24 @@ class Channel:
             header = await self.reader.readexactly(_LEN.size)
             (length,) = _LEN.unpack(header)
             if length > MAX_FRAME:
-                raise HandshakeError(f"oversized frame: {length}")
+                # post-handshake garbage (attacker or corruption), same
+                # class as a bad AEAD tag below: channel-fatal, normal drop
+                raise ChannelClosed(f"oversized frame: {length}")
             ct = await self.reader.readexactly(length)
         except (asyncio.IncompleteReadError, ConnectionError) as exc:
             raise ChannelClosed(str(exc)) from exc
         nonce = _NONCE.pack(self._recv_ctr) + b"\x00\x00\x00\x00"
         self._recv_ctr += 1
-        return self._recv_aead.decrypt(nonce, ct, None)
+        try:
+            return self._recv_aead.decrypt(nonce, ct, None)
+        except InvalidTag as exc:
+            # a frame failing the AEAD tag is wire corruption or an active
+            # attacker: protocol-fatal for the channel, but NOT an internal
+            # error — callers (the mesh) treat ChannelClosed as a normal
+            # drop/redial, so on-path garbage cannot traceback-spam logs.
+            # (ONLY InvalidTag: anything else here is a real bug and must
+            # surface loudly, not be laundered into a silent redial.)
+            raise ChannelClosed("integrity check failed") from exc
 
     def close(self) -> None:
         try:

@@ -113,7 +113,10 @@ class TestTransport:
         bogus = b"\x10\x00\x00\x00" + b"Z" * 16
         writer.write(bogus)
         await writer.drain()
-        assert await asyncio.wait_for(got, 2) == "InvalidTag"
+        # integrity failure surfaces as the channel-fatal ChannelClosed,
+        # not a raw InvalidTag traceback (on-path garbage must not be
+        # able to spam ERROR logs through the mesh handler)
+        assert await asyncio.wait_for(got, 2) == "ChannelClosed"
         writer.close()
         server.close()
 
@@ -179,7 +182,7 @@ class TestTransportFreshness:
         writer.write(bytes(frame_bytes))
         await writer.drain()
         kind, detail = await asyncio.wait_for(results.get(), 2)
-        assert kind == "err" and detail == "InvalidTag"
+        assert kind == "err" and detail == "ChannelClosed"
         writer.close()
         server.close()
 

@@ -1,0 +1,183 @@
+"""Public-surface robustness fuzz: junk bytes must never crash a node.
+
+Two surfaces take untrusted bytes directly from the network:
+
+* the RPC port (PortMux): seeded random junk — truncated HTTP, binary
+  garbage, oversized headers, malformed grpc-web bodies, abrupt
+  disconnects — must always end in a clean 4xx/close, never an
+  unhandled exception (the generic handler logs full tracebacks, so a
+  crash-per-junk-request floods the logs on the public port), and the
+  node must keep serving real clients afterwards;
+* the node mesh (transport): random corruption of AEAD-framed
+  ciphertext must terminate the channel, never deliver altered
+  plaintext (ChaCha20-Poly1305 integrity, pinned here under seeds
+  rather than the single tamper case in test_node.py).
+"""
+
+import asyncio
+import itertools
+import logging
+import random
+
+import pytest
+
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.net import transport
+from at2_node_tpu.node.config import Config
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.proto import at2_pb2 as pb
+
+_ports = itertools.count(48100)
+
+
+def _junk_requests(rng: random.Random):
+    """A zoo of malformed inputs for the public HTTP/1 surface."""
+    yield rng.randbytes(rng.randrange(1, 64))  # pure binary garbage
+    yield b"GET "  # truncated request line, then disconnect
+    yield b"POST /at2.AT2/GetBalance HTTP/1.1\r\n" + b"X: y\r\n" * 40
+    yield (
+        b"POST /at2.AT2/GetBalance HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Type: application/grpc-web+proto\r\n"
+        b"Content-Length: 99999999999999999999\r\n\r\n"
+    )
+    yield (
+        b"POST /%s HTTP/1.1\r\nHost: x\r\nContent-Type: application/grpc-web+proto\r\n"
+        b"Content-Length: 4\r\n\r\nabcd" % rng.randbytes(8).hex().encode()
+    )
+    yield (
+        b"POST /at2.AT2/GetBalance HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Type: application/grpc-web-text\r\n"
+        b"Content-Length: 7\r\n\r\nnot=b64"
+    )
+    yield (
+        b"POST /at2.AT2/GetBalance HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Type: application/grpc-web+proto\r\n"
+        b"Transfer-Encoding: chunked\r\n\r\n" + rng.randbytes(20)
+    )
+    # random mutation of a VALID request
+    frame = bytes([0, 0, 0, 0, 2, 0x0A, 0x00])
+    good = (
+        b"POST /at2.AT2/GetBalance HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Type: application/grpc-web+proto\r\n"
+        + b"Content-Length: %d\r\n\r\n" % len(frame)
+        + frame
+    )
+    mutated = bytearray(good)
+    for _ in range(rng.randrange(1, 6)):
+        mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+    yield bytes(mutated)
+
+
+@pytest.mark.parametrize("seed", [4, 19, 42])
+async def test_rpc_port_survives_junk_flood(seed, caplog):
+    cfg = Config(
+        node_address=f"127.0.0.1:{next(_ports)}",
+        rpc_address=f"127.0.0.1:{next(_ports)}",
+        sign_key=SignKeyPair.random(),
+        network_key=ExchangeKeyPair.random(),
+    )
+    svc = await Service.start(cfg)
+    rng = random.Random(seed)
+    host, _, port = cfg.rpc_address.rpartition(":")
+    try:
+        with caplog.at_level(logging.ERROR, logger="at2_node_tpu.net.webmux"):
+            for junk in _junk_requests(rng):
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        host, int(port)
+                    )
+                    writer.write(junk)
+                    await writer.drain()
+                    if rng.random() < 0.5:
+                        writer.close()  # abrupt disconnect mid-request
+                    else:
+                        await asyncio.wait_for(
+                            reader.read(4096), timeout=2
+                        )
+                        writer.close()
+                except (ConnectionError, asyncio.TimeoutError, OSError):
+                    pass
+        # junk must not generate ANY error-level record (connection-level
+        # OR handler-level tracebacks both count as spam)
+        errors = [r for r in caplog.records if r.levelno >= logging.ERROR]
+        assert not errors, [r.message for r in errors]
+
+        # and the node still serves a real client cleanly
+        reader, writer = await asyncio.open_connection(host, int(port))
+        msg = pb.GetBalanceRequest(sender=b"\x01" * 32).SerializeToString()
+        frame = bytes([0]) + len(msg).to_bytes(4, "big") + msg
+        writer.write(
+            b"POST /at2.AT2/GetBalance HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/grpc-web+proto\r\n"
+            b"Connection: close\r\n"
+            + b"Content-Length: %d\r\n\r\n" % len(frame)
+            + frame
+        )
+        await writer.drain()
+        resp = await asyncio.wait_for(reader.read(-1), timeout=10)
+        writer.close()
+        assert b"200 OK" in resp.split(b"\r\n")[0]
+        assert b"grpc-status: 0" in resp
+    finally:
+        await svc.close()
+
+
+@pytest.mark.parametrize("seed", [8, 33, 77])
+async def test_transport_rejects_random_corruption(seed):
+    """Bit-flipped AEAD records: the receiving channel must error out,
+    never surface altered plaintext."""
+    rng = random.Random(seed)
+    server_kp, client_kp = ExchangeKeyPair.random(), ExchangeKeyPair.random()
+    received = []
+    accepted = asyncio.get_event_loop().create_future()
+    handler_done = asyncio.Event()
+
+    async def on_conn(reader, writer):
+        try:
+            channel = await transport.accept(reader, writer, server_kp)
+            accepted.set_result(channel)
+            while True:
+                received.append(await channel.recv())
+        except (transport.ChannelClosed, transport.HandshakeError, ConnectionError):
+            pass
+        except Exception as exc:  # pragma: no cover
+            received.append(("UNEXPECTED", repr(exc)))
+        finally:
+            handler_done.set()
+
+    port = next(_ports)
+    server = await asyncio.start_server(on_conn, "127.0.0.1", port)
+    try:
+        channel = await transport.connect("127.0.0.1", port, client_kp)
+        await channel.send(b"legit-before")
+        srv_channel = await asyncio.wait_for(accepted, timeout=5)
+
+        # inject a corrupted sealed frame through the channel's raw
+        # socket: seal a frame with the SAME counter the receiver expects
+        # next, then flip random bits before writing
+        import struct
+
+        nonce = struct.pack("<Q", channel._send_ctr) + b"\x00\x00\x00\x00"
+        ct = channel._send_aead.encrypt(nonce, b"attacker-target", None)
+        sealed = struct.pack("<I", len(ct)) + ct
+        corrupt = bytearray(sealed)
+        for _ in range(rng.randrange(1, 5)):
+            corrupt[4 + rng.randrange(len(ct))] ^= 1 << rng.randrange(8)
+        if bytes(corrupt) == sealed:
+            corrupt[4] ^= 0xFF
+        channel.writer.write(bytes(corrupt))
+        await channel.writer.drain()
+
+        # the receiver must tear down (handler exits via ChannelClosed)
+        # without delivering the forgery
+        await asyncio.wait_for(handler_done.wait(), timeout=5)
+        assert b"legit-before" in received
+        assert not any(
+            isinstance(r, bytes) and b"attacker" in r for r in received
+        ), "corrupted frame surfaced as plaintext"
+        assert not any(isinstance(r, tuple) for r in received), received
+        channel.close()
+        srv_channel.close()
+    finally:
+        server.close()
+        await server.wait_closed()
