@@ -204,3 +204,58 @@ async def test_overflow_rich_accounts_match_model(seed):
             next_seq[sender] = seq + 1
     await _assert_agree(accounts, model, users + [whale])
     assert overflowed > 0, "the overflow path never fired; weaken the seed"
+
+
+class RingModel:
+    """Independent model of the recent-transactions ring (reference
+    recent_transactions.rs:7,149-200): capacity 10 FIFO, put dedups by
+    (sender, sequence), update rewrites the LATEST matching entry's
+    state and is a NOP when absent."""
+
+    CAP = 10
+
+    def __init__(self):
+        self.entries = []  # (sender, seq, state)
+
+    def put(self, sender, seq):
+        if any(e[0] == sender and e[1] == seq for e in self.entries):
+            return
+        self.entries.append((sender, seq, "PENDING"))
+        if len(self.entries) > self.CAP:
+            self.entries.pop(0)
+
+    def update(self, sender, seq, state):
+        for i in range(len(self.entries) - 1, -1, -1):
+            if self.entries[i][0] == sender and self.entries[i][1] == seq:
+                self.entries[i] = (sender, seq, state)
+                return
+
+
+@pytest.mark.parametrize("seed", [6, 47, 88])
+async def test_recent_ring_matches_model(seed):
+    from at2_node_tpu.types import ThinTransaction, TransactionState
+
+    rng = random.Random(seed)
+    recent = RecentTransactions()
+    model = RingModel()
+    users = [bytes([i]) * 32 for i in range(1, 4)]
+    for _ in range(250):
+        sender = rng.choice(users)
+        seq = rng.randrange(1, 15)
+        roll = rng.random()
+        if roll < 0.55:
+            await recent.put(sender, seq, ThinTransaction(b"r" * 32, 1))
+            model.put(sender, seq)
+        else:
+            state = rng.choice(
+                (TransactionState.SUCCESS, TransactionState.FAILURE)
+            )
+            await recent.update(sender, seq, state)
+            model.update(sender, seq, state.name)
+        got = [
+            (t.sender, t.sender_sequence, t.state.name)
+            for t in await recent.get_all()
+        ]
+        # get_all's order is part of the contract: oldest first
+        # (recent.py export docstring; GetLatestTransactions relies on it)
+        assert got == model.entries, (got, model.entries)

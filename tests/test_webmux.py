@@ -174,3 +174,69 @@ class TestGrpcWeb:
             assert status == 3
         finally:
             await service.close()
+
+
+class TestConnectionBounds:
+    async def test_idle_splice_flood_does_not_starve_http1(self, monkeypatch):
+        """Fill the splice budget with idle native-gRPC-preface
+        connections: excess splices are rejected, and grpc-web service
+        on the same port keeps working throughout."""
+        from at2_node_tpu.net import webmux as webmux_mod
+
+        monkeypatch.setattr(webmux_mod, "_MAX_SPLICES", 4)
+        cfg = _single_node_config()
+        service = await Service.start(cfg)
+        host, _, port = cfg.rpc_address.rpartition(":")
+        writers = []
+        try:
+            # 8 idle splices against a cap of 4: all hold only the
+            # 4-byte preface so the mux pins pump tasks for each
+            for _ in range(8):
+                reader, writer = await asyncio.open_connection(host, int(port))
+                writer.write(b"PRI ")
+                await writer.drain()
+                writers.append(writer)
+            await asyncio.sleep(0.2)  # let the mux route/reject them
+            # exactly the cap: 4 accepted (proving the splice path DID
+            # engage), 4 rejected
+            assert service._mux._n_splices == 4
+
+            # a real grpc-web call on the same port is unaffected
+            status, reply = await _grpc_web_call(
+                cfg.rpc_address, "GetBalance",
+                pb.GetBalanceRequest(sender=b"\x01" * 32),
+            )
+            assert status == 0
+            assert pb.GetBalanceReply.FromString(reply).amount == 100_000
+        finally:
+            for w in writers:
+                w.close()
+            await service.close()
+
+    async def test_http1_conn_cap_answers_503(self, monkeypatch):
+        from at2_node_tpu.net import webmux as webmux_mod
+
+        monkeypatch.setattr(webmux_mod, "_MAX_HTTP1_CONNS", 2)
+        cfg = _single_node_config()
+        service = await Service.start(cfg)
+        host, _, port = cfg.rpc_address.rpartition(":")
+        holders = []
+        try:
+            # two keep-alive connections occupy the whole budget
+            for _ in range(2):
+                reader, writer = await asyncio.open_connection(host, int(port))
+                writer.write(b"XGET")  # non-PRI head: routed to HTTP/1
+                await writer.drain()
+                holders.append(writer)
+            await asyncio.sleep(0.2)
+            # the third is turned away with 503, not hung or crashed
+            reader, writer = await asyncio.open_connection(host, int(port))
+            writer.write(b"XGET")
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), timeout=5)
+            assert b"503" in line
+            writer.close()
+        finally:
+            for w in holders:
+                w.close()
+            await service.close()
