@@ -1,0 +1,87 @@
+"""Pins for the browser wallet page (web/wallet.html).
+
+No JS runtime exists in this image, so the page cannot be executed in
+CI; its wire behavior (grpc-web-text framing, protobuf shapes, CORS) is
+what the interop tier pins with stock HTTP clients. What CAN be checked
+offline, is checked here:
+
+* the PKCS8 prefix the page uses to import raw Ed25519 seeds into
+  WebCrypto is byte-identical to the real PKCS8 encoding `cryptography`
+  produces — the single most fragile constant on the page (a wrong
+  prefix silently derives a different key);
+* the signed byte layout the page builds (recipient || amount LE, no
+  sequence) matches types.ThinTransaction.signing_bytes, so a browser
+  signature verifies server-side;
+* the page references the correct service path and content type.
+"""
+
+import os
+import re
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519
+
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.types import ThinTransaction
+
+PAGE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "web",
+    "wallet.html",
+)
+
+
+def _page() -> str:
+    with open(PAGE, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_pkcs8_prefix_matches_real_encoding():
+    match = re.search(r'PKCS8_PREFIX = hexToBytes\("([0-9a-f]+)"\)', _page())
+    assert match, "PKCS8 prefix constant missing from the page"
+    page_prefix = bytes.fromhex(match.group(1))
+
+    seed = bytes(range(32))
+    key = ed25519.Ed25519PrivateKey.from_private_bytes(seed)
+    pkcs8 = key.private_bytes(
+        serialization.Encoding.DER,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    assert pkcs8 == page_prefix + seed, (
+        "the page's PKCS8 wrapper diverges from the real encoding; "
+        "WebCrypto importKey would build a different key"
+    )
+
+
+def test_signing_layout_matches_canonical():
+    page = _page()
+    # the page signs concat(recipient, amountLe) with LE u64 — the same
+    # canonical form ThinTransaction.signing_bytes defines
+    assert "setBigUint64(0, amount, true)" in page  # little-endian
+    assert "concat(recipient, amountLe)" in page
+    thin = ThinTransaction(b"\x07" * 32, 513)
+    assert thin.signing_bytes() == b"\x07" * 32 + (513).to_bytes(8, "little")
+    # a signature over that layout verifies with the repo's own keys
+    kp = SignKeyPair.from_hex("2b" * 32)
+    sig = kp.sign(thin.signing_bytes())
+    from at2_node_tpu.crypto.keys import verify_one
+
+    assert verify_one(kp.public, thin.signing_bytes(), sig)
+
+
+def test_page_targets_the_served_surface():
+    page = _page()
+    assert "/at2.AT2/" in page
+    assert "application/grpc-web-text" in page
+    # field numbers used for SendAsset match at2.proto's
+    # (sender=1, sequence=2, recipient=3, amount=4, signature=5)
+    assert "pbBytes(1, keyPair.publicKey)" in page
+    assert "pbUint(2, sequence)" in page
+    assert "pbBytes(3, recipient)" in page
+    assert "pbUint(4, amount)" in page
+    assert "pbBytes(5, signature)" in page
+    # FullTransaction decode uses the right field map (timestamp=1,
+    # sender=2, recipient=3, amount=4, state=5 — proto/at2.proto:61-75)
+    assert "t[3] ? bytesToHex(t[3][0])" in page  # recipient
+    assert "stateNames[Number(t[5]" in page  # state
