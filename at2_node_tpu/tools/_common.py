@@ -1,0 +1,39 @@
+"""Shared helpers for the benchmark/demo tools."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List
+
+from ..crypto.keys import ExchangeKeyPair, SignKeyPair
+from ..net.peers import Peer
+from ..node.config import Config
+
+
+def make_net_configs(
+    n: int, ports: Iterator[int], **config_overrides
+) -> List[Config]:
+    """N full-mesh node Configs with fresh keys: THE one builder for the
+    tools' in-process nets (plane_bench / scale_demo / e2e_bench), so
+    Config/Peer construction changes land in one place."""
+    cfgs = [
+        Config(
+            node_address=f"127.0.0.1:{next(ports)}",
+            rpc_address=f"127.0.0.1:{next(ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+            **config_overrides,
+        )
+        for _ in range(n)
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.nodes = [
+            Peer(o.node_address, o.network_key.public, o.sign_key.public)
+            for j, o in enumerate(cfgs)
+            if j != i
+        ]
+    return cfgs
+
+
+def port_counter(start: int) -> Iterator[int]:
+    return itertools.count(start)

@@ -142,21 +142,9 @@ async def _phase_tpu_inprocess(
 
     shared = TpuBatchVerifier(batch_size=256, max_delay=0.005)
     await shared.warmup()
-    cfgs = [
-        Config(
-            node_address=f"127.0.0.1:{next(_ports)}",
-            rpc_address=f"127.0.0.1:{next(_ports)}",
-            sign_key=SignKeyPair.random(),
-            network_key=ExchangeKeyPair.random(),
-        )
-        for _ in range(n_nodes)
-    ]
-    for i, cfg in enumerate(cfgs):
-        cfg.nodes = [
-            Peer(o.node_address, o.network_key.public, o.sign_key.public)
-            for j, o in enumerate(cfgs)
-            if j != i
-        ]
+    from ._common import make_net_configs
+
+    cfgs = make_net_configs(n_nodes, _ports)
     services: List[Service] = []
     try:
         for cfg in cfgs:

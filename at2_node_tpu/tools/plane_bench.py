@@ -1,0 +1,108 @@
+"""In-process broadcast-plane microbenchmark (the firehose number).
+
+Measures what the broadcast plane + verifier path do when batches
+actually form, WITHOUT the loadgen/gRPC/subprocess overhead of the full
+e2e configs: N Services in one process over real localhost sockets, a
+pre-signed burst of payloads submitted straight into node 0's broadcast,
+committed-tx/s measured to full commitment on every node.
+
+This is the reproducible source of BENCH_E2E.json's
+``inprocess_firehose`` figure (~393 tx/s on the 1-core build host; the
+round-3 progression's earlier points were measured under cProfile and
+read lower).
+
+Usage:
+    python -m at2_node_tpu.tools.plane_bench [--nodes 3] [--txs 300]
+        [--verifier cpu] [--out -]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+from ..broadcast.messages import Payload
+from ..crypto.keys import SignKeyPair
+from ..node.config import VerifierConfig
+from ..node.service import Service
+from ..types import ThinTransaction
+from ._common import make_net_configs, port_counter
+
+_ports = port_counter(52200)
+
+
+async def run(nodes: int, txs: int, verifier: str, timeout: float) -> dict:
+    cfgs = make_net_configs(
+        nodes, _ports, verifier=VerifierConfig(kind=verifier)
+    )
+    services = []
+    try:
+        for c in cfgs:  # start INSIDE the try: a mid-start failure must
+            services.append(await Service.start(c))  # close earlier nodes
+        sender = SignKeyPair.from_hex("77" * 32)
+        recipient = SignKeyPair.from_hex("78" * 32).public
+        payloads = []
+        for seq in range(1, txs + 1):
+            tx = ThinTransaction(recipient, 1)
+            payloads.append(
+                Payload(sender.public, seq, tx, sender.sign(tx.signing_bytes()))
+            )
+
+        t0 = time.perf_counter()
+        for p in payloads:
+            await services[0].broadcast.broadcast(p)
+        timed_out = False
+        while any(s.committed < txs for s in services):
+            await asyncio.sleep(0.02)
+            if time.perf_counter() - t0 > timeout:
+                timed_out = True
+                break
+        dt = time.perf_counter() - t0
+        committed = [s.committed for s in services]
+        stats = services[0].snapshot_stats()
+        return {
+            "config": "in-process firehose (plane microbenchmark)",
+            "nodes": nodes,
+            "verifier": verifier,
+            "submitted": txs,
+            "committed_per_node": committed,
+            "seconds": round(dt, 3),
+            # a timed-out run's rate is NOT a measurement
+            "timed_out": timed_out,
+            "committed_tx_per_sec": (
+                round(min(committed) / dt, 1) if dt and not timed_out else 0.0
+            ),
+            "node0_stats": {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in sorted(stats.items())
+            },
+        }
+    finally:
+        for s in services:
+            await s.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--txs", type=int, default=300)
+    ap.add_argument("--verifier", default="cpu", choices=("cpu", "tpu", "pool"))
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--out", default="-")
+    args = ap.parse_args(argv)
+    result = asyncio.run(run(args.nodes, args.txs, args.verifier, args.timeout))
+    blob = json.dumps(result, indent=1)
+    if args.out == "-":
+        print(blob)
+    else:
+        with open(args.out, "w") as f:
+            f.write(blob)
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

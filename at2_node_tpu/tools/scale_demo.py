@@ -38,34 +38,20 @@ import sys
 import time
 from typing import List
 
-from ..crypto.keys import ExchangeKeyPair, SignKeyPair
-from ..net.peers import Peer
-from ..node.config import Config
+from ..crypto.keys import SignKeyPair
 from ..node.service import Service
+from ._common import make_net_configs, port_counter
 from .loadgen import run_load
 
-_ports = itertools.count(47000)
+_ports = port_counter(47000)
 
 
 def _make_configs(n: int, echo_threshold: int, ready_threshold: int):
-    cfgs = [
-        Config(
-            node_address=f"127.0.0.1:{next(_ports)}",
-            rpc_address=f"127.0.0.1:{next(_ports)}",
-            sign_key=SignKeyPair.random(),
-            network_key=ExchangeKeyPair.random(),
-            echo_threshold=echo_threshold,
-            ready_threshold=ready_threshold,
-        )
-        for _ in range(n)
-    ]
-    for i, cfg in enumerate(cfgs):
-        cfg.nodes = [
-            Peer(o.node_address, o.network_key.public, o.sign_key.public)
-            for j, o in enumerate(cfgs)
-            if j != i
-        ]
-    return cfgs
+    return make_net_configs(
+        n, _ports,
+        echo_threshold=echo_threshold,
+        ready_threshold=ready_threshold,
+    )
 
 
 async def _phase_net(
