@@ -49,6 +49,11 @@ def cmd_run(args: argparse.Namespace) -> int:
         level=os.environ.get("AT2_LOG", "WARNING").upper(),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    # multi-host pool bring-up (no-op unless AT2_COORDINATOR is set);
+    # must precede the first JAX backend touch in this process
+    from ..parallel.multihost import maybe_initialize
+
+    maybe_initialize()
     config = Config.load(sys.stdin)
 
     async def main() -> None:

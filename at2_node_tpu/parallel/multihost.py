@@ -1,0 +1,94 @@
+"""Multi-host runtime bring-up (jax.distributed over ICI/DCN).
+
+SURVEY.md §7 step 8 ends at the single-host multi-chip pool and defers
+multi-host; this module is the bring-up seam for that step — with the
+scaling model stated honestly:
+
+* **Verification pools stay host-local by design.** A node's
+  `PoolVerifier` flushes ITS OWN traffic whenever its accumulator
+  fills; two hosts' pools can never enter one SPMD program in lockstep,
+  so a cross-process mesh under a per-node verifier would hang at its
+  first collective. On a multi-host runtime, `pool.make_mesh()`
+  therefore builds over this process's LOCAL devices only.
+* **Cross-host scale-out is the replication dimension itself** (SURVEY
+  §2.3 P1): more nodes, each owning its host's chips — exactly how the
+  reference scales (one host's workers per node, rpc.rs:125), with the
+  per-host verifier ceiling raised from CPU cores to a TPU slice.
+* What the distributed runtime buys here: nodes on multi-host POD
+  slices (where one process only addresses its local chips) still get
+  their full local complement, plus single-controller SPMD jobs — the
+  1M-replay benchmark, the multichip dryrun — can span hosts because a
+  SINGLE driver feeds every process the same program in lockstep.
+
+Configuration is by environment (the deployment shape k8s/GCE gives):
+
+    AT2_COORDINATOR   host:port of process 0 (presence enables init)
+    AT2_NUM_PROCESSES total process count
+    AT2_PROCESS_ID    this process's index
+
+`maybe_initialize()` is a no-op without AT2_COORDINATOR, so single-host
+deployments never pay the coordinator round-trip; with it, call once
+before any JAX use (the server CLI does this before Service.start when
+the variables are present).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize() -> bool:
+    """Initialize jax.distributed from AT2_* env vars; True if the
+    multi-host runtime is (now or already) up, False when unconfigured.
+
+    Idempotent; must run before the first JAX backend touch in the
+    process (jax.distributed's own constraint)."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = os.environ.get("AT2_COORDINATOR")
+    if not coordinator:
+        return False
+    try:
+        num_processes = int(os.environ["AT2_NUM_PROCESSES"])
+        process_id = int(os.environ["AT2_PROCESS_ID"])
+    except (KeyError, ValueError) as exc:
+        raise ValueError(
+            "AT2_COORDINATOR is set, so AT2_NUM_PROCESSES and "
+            "AT2_PROCESS_ID must both be set to integers — the three "
+            "variables configure the multi-host runtime together"
+        ) from exc
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "multi-host runtime up: process %s/%s, %d local / %d global devices",
+        os.environ["AT2_PROCESS_ID"],
+        os.environ["AT2_NUM_PROCESSES"],
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+    return True
+
+
+def process_info() -> dict:
+    """Operator-facing snapshot of the distributed topology."""
+    import jax
+
+    return {
+        "initialized": _initialized,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }

@@ -50,9 +50,18 @@ def make_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
     The pool is data-parallel only, so the mesh is 1-D no matter how many
     chips participate; on a real v5e-8 slice the axis spans all 8 chips and
     the validity-sum AllReduce rides ICI.
+
+    On a multi-host runtime (jax.distributed up, process_count > 1) the
+    default is this process's LOCAL devices: a per-node verifier flushes
+    its own traffic on its own schedule, so its compiled programs can
+    never enter a cross-process SPMD collective in lockstep — a global
+    mesh here would hang at the first flush (parallel/multihost.py
+    explains the scaling model).
     """
     if devices is None:
-        devices = jax.devices()
+        devices = (
+            jax.local_devices() if jax.process_count() > 1 else jax.devices()
+        )
     return Mesh(np.asarray(devices), (BATCH_AXIS,))
 
 

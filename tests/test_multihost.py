@@ -1,0 +1,88 @@
+"""Multi-host bring-up seam (parallel/multihost.py).
+
+Real multi-host hardware is not available in CI; what IS testable:
+
+* unconfigured environments are a strict no-op (no coordinator dial,
+  no env mutation) — single-host deployments never pay for the seam;
+* a 1-process distributed runtime (jax.distributed with
+  num_processes=1, the degenerate but fully real code path) comes up in
+  a subprocess, reports a coherent topology, and the sharded verifier
+  pool works over the resulting global mesh.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import at2_node_tpu.parallel.multihost as mh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_unconfigured_is_noop(monkeypatch):
+    monkeypatch.delenv("AT2_COORDINATOR", raising=False)
+    assert mh.maybe_initialize() is False
+    assert mh._initialized is False
+
+
+@pytest.mark.slow  # subprocess pays a fresh XLA-CPU compile (~1.5 min)
+def test_single_process_distributed_runtime_and_pool():
+    """Subprocess isolation: jax.distributed.initialize is process-global
+    and cannot be torn down for the other tests."""
+    code = """
+import os, sys
+sys.path.insert(0, @REPO@)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+os.environ["AT2_COORDINATOR"] = "127.0.0.1:@PORT@"
+os.environ["AT2_NUM_PROCESSES"] = "1"
+os.environ["AT2_PROCESS_ID"] = "0"
+from at2_node_tpu.parallel import multihost
+assert multihost.maybe_initialize() is True
+assert multihost.maybe_initialize() is True  # idempotent
+info = multihost.process_info()
+assert info["initialized"] and info["process_count"] == 1
+assert info["global_devices"] == info["local_devices"] == 4
+
+# the pool's default mesh now IS the global mesh; verify through it
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.parallel.pool import make_mesh, verify_batch_sharded
+kp = SignKeyPair.from_hex("51" * 32)
+msgs = [b"mh%d" % i for i in range(8)]
+sigs = [kp.sign(m) for m in msgs]
+bad = sigs[:3] + [b"\\x00" * 64] + sigs[4:]
+ok = verify_batch_sharded([kp.public] * 8, msgs, bad, mesh=make_mesh())
+assert list(ok) == [True, True, True, False, True, True, True, True], list(ok)
+print("MULTIHOST_OK", info["process_count"], info["global_devices"])
+""".replace("@REPO@", repr(REPO)).replace("@PORT@", str(_free_port()))
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "MULTIHOST_OK 1 4" in proc.stdout, proc.stdout
+
+
+def test_partial_configuration_raises_clearly(monkeypatch):
+    monkeypatch.setattr(mh, "_initialized", False)
+    monkeypatch.setenv("AT2_COORDINATOR", "127.0.0.1:1")
+    monkeypatch.delenv("AT2_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("AT2_PROCESS_ID", raising=False)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="AT2_NUM_PROCESSES"):
+        mh.maybe_initialize()
