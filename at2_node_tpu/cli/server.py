@@ -49,8 +49,9 @@ def cmd_run(args: argparse.Namespace) -> int:
         level=os.environ.get("AT2_LOG", "WARNING").upper(),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    # multi-host pool bring-up (no-op unless AT2_COORDINATOR is set);
-    # must precede the first JAX backend touch in this process
+    # multi-host bring-up; a no-op returning immediately (and importing
+    # no jax) unless AT2_COORDINATOR is configured, so single-host
+    # CPU-verifier servers stay light at boot
     from ..parallel.multihost import maybe_initialize
 
     maybe_initialize()

@@ -1,5 +1,22 @@
-"""Multi-chip parallelism: the sharded verifier pool (see pool.py)."""
+"""Multi-chip parallelism: the sharded verifier pool (see pool.py) and
+the multi-host runtime seam (multihost.py).
 
-from .pool import PoolVerifier, make_mesh, pool_bucket_for, verify_batch_sharded
+Lazy exports (PEP 562): importing this package must NOT pull in jax —
+CPU-verifier node processes never touch it, and a jax import costs tens
+of seconds of startup across a small host's servers.
+"""
 
-__all__ = ["PoolVerifier", "make_mesh", "pool_bucket_for", "verify_batch_sharded"]
+__all__ = [
+    "PoolVerifier",
+    "make_mesh",
+    "pool_bucket_for",
+    "verify_batch_sharded",
+]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from . import pool
+
+        return getattr(pool, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

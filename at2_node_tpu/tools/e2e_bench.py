@@ -44,7 +44,7 @@ REPO = os.path.dirname(
 )
 SERVER = [sys.executable, "-m", "at2_node_tpu.cli.server"]
 
-_ports = itertools.count(46000)
+_ports = itertools.count(26000)
 
 
 def _run_cli(argv, stdin=None) -> str:

@@ -31,7 +31,7 @@ from ..node.service import Service
 from ..types import ThinTransaction
 from ._common import make_net_configs, port_counter
 
-_ports = port_counter(52200)
+_ports = port_counter(27200)
 
 
 async def run(nodes: int, txs: int, verifier: str, timeout: float) -> dict:

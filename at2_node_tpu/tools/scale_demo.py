@@ -43,7 +43,7 @@ from ..node.service import Service
 from ._common import make_net_configs, port_counter
 from .loadgen import run_load
 
-_ports = port_counter(47000)
+_ports = port_counter(28000)
 
 
 def _make_configs(n: int, echo_threshold: int, ready_threshold: int):

@@ -40,7 +40,7 @@ wait_for_port_connect() { # port [timeout_s]
 
 # start_network [base_port]: boots N_NODES servers; sets RPC_PORT_0..2
 start_network() {
-    base=${1:-$((RANDOM % 20000 + 30000))}
+    base=${1:-$((RANDOM % 10000 + 10000))}  # 10000-19999: below both the ephemeral range (32768+) and the Python suites' fixed bases (20500+)
     n=0
     while [ "$n" -lt "$N_NODES" ]; do
         server config new "127.0.0.1:$((base + n * 2))" "127.0.0.1:$((base + n * 2 + 1))" \

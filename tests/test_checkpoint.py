@@ -19,7 +19,7 @@ from at2_node_tpu.node.config import CheckpointConfig, Config
 from at2_node_tpu.node.service import Service
 from at2_node_tpu.types import ThinTransaction, TransactionState
 
-_ports = itertools.count(45500)
+_ports = itertools.count(20500)
 
 
 class TestSnapshotRoundtrip:

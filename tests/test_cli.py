@@ -28,7 +28,7 @@ CLIENT = [sys.executable, "-m", "at2_node_tpu.cli.client"]
 TICK = 0.1
 TIMEOUT = 30.0  # interpreter startup is slower than a Rust binary
 
-_ports = itertools.count(44000)
+_ports = itertools.count(21000)
 
 
 def run_cli(argv, stdin=None, check=True):

@@ -42,7 +42,7 @@ from at2_node_tpu.node.service import Service
 TICK = 0.1
 TIMEOUT = 15.0
 
-_ports = itertools.count(46200)
+_ports = itertools.count(21600)
 
 FAUCET = 100_000
 

@@ -37,7 +37,7 @@ from at2_node_tpu.node.config import Config
 from at2_node_tpu.node.service import Service
 from at2_node_tpu.proto import at2_pb2 as pb
 
-_ports = itertools.count(47400)
+_ports = itertools.count(22400)
 
 # the pinned transcripts query this sender (baked into their bytes)
 PINNED_SENDER = bytes.fromhex(

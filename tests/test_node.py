@@ -24,7 +24,7 @@ from at2_node_tpu.node.service import Service
 TICK = 0.1
 TIMEOUT = 10.0
 
-_ports = itertools.count(43000)
+_ports = itertools.count(23000)
 
 
 def make_configs(n):

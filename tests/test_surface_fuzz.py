@@ -27,7 +27,7 @@ from at2_node_tpu.node.config import Config
 from at2_node_tpu.node.service import Service
 from at2_node_tpu.proto import at2_pb2 as pb
 
-_ports = itertools.count(48100)
+_ports = itertools.count(24100)
 
 
 def _junk_requests(rng: random.Random):

@@ -20,7 +20,7 @@ from at2_node_tpu.node.service import Service
 from at2_node_tpu.proto import at2_pb2 as pb
 from at2_node_tpu.types import ThinTransaction
 
-_ports = itertools.count(45100)
+_ports = itertools.count(25100)
 
 
 def _single_node_config():
