@@ -30,14 +30,34 @@ GOSSIP = 1
 ECHO = 2
 READY = 3
 REQUEST = 4
+# Ledger-history catchup plane (the reference's open "catchup mechanism"
+# roadmap item, /root/reference/README.md:53 — see ledger/history.py and
+# node/service.py `_catchup_once` for the protocol):
+HIST_IDX_REQ = 5  # "send me your commit frontier"
+HIST_IDX = 6  # per-sender committed-sequence frontier
+HIST_REQ = 7  # "send me sender X's committed payloads in [lo, hi]"
+HIST_BATCH = 8  # a batch of committed payloads
 
 _PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
 _ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
 _REQUEST = struct.Struct("<32sI32s")  # sender, seq, hash
+_HIST_IDX_REQ = struct.Struct("<Q")  # nonce
+_HIST_HDR = struct.Struct("<QI")  # nonce, entry count (HIST_IDX / HIST_BATCH)
+_HIST_IDX_ENTRY = struct.Struct("<32sI")  # sender, last committed sequence
+_HIST_REQ = struct.Struct("<Q32sII")  # nonce, sender, from_seq, to_seq
 
 PAYLOAD_WIRE = 1 + _PAYLOAD.size
 ATTEST_WIRE = 1 + _ATTEST.size
 REQUEST_WIRE = 1 + _REQUEST.size
+HIST_IDX_REQ_WIRE = 1 + _HIST_IDX_REQ.size
+HIST_REQ_WIRE = 1 + _HIST_REQ.size
+HIST_HDR_WIRE = 1 + _HIST_HDR.size  # variable records: header + entries
+
+# A legitimate frame coalesces at most MAX_BATCH_MSGS = 1024 messages
+# (net/peers.py); 4x that is the malformed bound. Bounds the parse
+# amplification of frames dense with the 9-byte catchup request (must
+# match kMaxMsgsPerFrame in native/at2_ingest.cpp).
+MAX_MSGS_PER_FRAME = 4096
 
 _ECHO_TAG = b"at2-node-tpu/echo/v1"
 _READY_TAG = b"at2-node-tpu/ready/v1"
@@ -151,11 +171,109 @@ class ContentRequest:
         return ContentRequest(sender, seq, chash)
 
 
+@dataclass(frozen=True)
+class HistoryIndexRequest:
+    """Ask a peer for its commit frontier (first step of a catchup
+    session). ``nonce`` ties responses to the requesting session; like
+    ContentRequest, unsigned — accepted only over authenticated channels."""
+
+    nonce: int
+
+    def encode(self) -> bytes:
+        return bytes([HIST_IDX_REQ]) + _HIST_IDX_REQ.pack(self.nonce)
+
+    @staticmethod
+    def decode_body(body: bytes) -> "HistoryIndexRequest":
+        (nonce,) = _HIST_IDX_REQ.unpack(body)
+        return HistoryIndexRequest(nonce)
+
+
+@dataclass(frozen=True)
+class HistoryIndex:
+    """A peer's commit frontier: (sender, last committed sequence) pairs.
+    Variable length: header carries the entry count."""
+
+    nonce: int
+    entries: tuple  # of (sender: bytes, last_seq: int)
+
+    def encode(self) -> bytes:
+        parts = [
+            bytes([HIST_IDX]),
+            _HIST_HDR.pack(self.nonce, len(self.entries)),
+        ]
+        parts.extend(
+            _HIST_IDX_ENTRY.pack(sender, seq) for sender, seq in self.entries
+        )
+        return b"".join(parts)
+
+    @staticmethod
+    def decode_body(nonce: int, body: bytes) -> "HistoryIndex":
+        n = len(body) // _HIST_IDX_ENTRY.size
+        entries = tuple(
+            _HIST_IDX_ENTRY.unpack_from(body, i * _HIST_IDX_ENTRY.size)
+            for i in range(n)
+        )
+        return HistoryIndex(nonce, entries)
+
+
+@dataclass(frozen=True)
+class HistoryRequest:
+    """Pull a sender's committed payloads for sequences [from_seq, to_seq]
+    (inclusive); the server clamps the range (see ledger/history.py)."""
+
+    nonce: int
+    sender: bytes
+    from_seq: int
+    to_seq: int
+
+    def encode(self) -> bytes:
+        return bytes([HIST_REQ]) + _HIST_REQ.pack(
+            self.nonce, self.sender, self.from_seq, self.to_seq
+        )
+
+    @staticmethod
+    def decode_body(body: bytes) -> "HistoryRequest":
+        nonce, sender, lo, hi = _HIST_REQ.unpack(body)
+        return HistoryRequest(nonce, sender, lo, hi)
+
+
+@dataclass(frozen=True)
+class HistoryBatch:
+    """Committed payloads served from a peer's history store. The
+    receiving catchup session trusts NO single peer: a slot is applied
+    only once `catchup quorum` peers returned the same content hash AND
+    the client signature verifies (node/service.py `_catchup_once`)."""
+
+    nonce: int
+    payloads: tuple  # of Payload
+
+    def encode(self) -> bytes:
+        parts = [
+            bytes([HIST_BATCH]),
+            _HIST_HDR.pack(self.nonce, len(self.payloads)),
+        ]
+        parts.extend(p.encode()[1:] for p in self.payloads)
+        return b"".join(parts)
+
+    @staticmethod
+    def decode_body(nonce: int, body: bytes) -> "HistoryBatch":
+        n = len(body) // _PAYLOAD.size
+        payloads = tuple(
+            Payload.decode_body(
+                body[i * _PAYLOAD.size : (i + 1) * _PAYLOAD.size]
+            )
+            for i in range(n)
+        )
+        return HistoryBatch(nonce, payloads)
+
+
 def parse_frame(frame: bytes) -> list:
     """Split a frame into messages (frames may coalesce many)."""
     out = []
     view = memoryview(frame)
     while view:
+        if len(out) >= MAX_MSGS_PER_FRAME:
+            raise WireError("frame exceeds message cap")
         kind = view[0]
         if kind == GOSSIP:
             if len(view) < PAYLOAD_WIRE:
@@ -172,6 +290,32 @@ def parse_frame(frame: bytes) -> list:
                 raise WireError("truncated content request")
             out.append(ContentRequest.decode_body(bytes(view[1:REQUEST_WIRE])))
             view = view[REQUEST_WIRE:]
+        elif kind == HIST_IDX_REQ:
+            if len(view) < HIST_IDX_REQ_WIRE:
+                raise WireError("truncated history index request")
+            out.append(
+                HistoryIndexRequest.decode_body(bytes(view[1:HIST_IDX_REQ_WIRE]))
+            )
+            view = view[HIST_IDX_REQ_WIRE:]
+        elif kind == HIST_REQ:
+            if len(view) < HIST_REQ_WIRE:
+                raise WireError("truncated history request")
+            out.append(HistoryRequest.decode_body(bytes(view[1:HIST_REQ_WIRE])))
+            view = view[HIST_REQ_WIRE:]
+        elif kind in (HIST_IDX, HIST_BATCH):
+            if len(view) < HIST_HDR_WIRE:
+                raise WireError("truncated history header")
+            nonce, count = _HIST_HDR.unpack(bytes(view[1:HIST_HDR_WIRE]))
+            entry = _HIST_IDX_ENTRY.size if kind == HIST_IDX else _PAYLOAD.size
+            total = HIST_HDR_WIRE + count * entry
+            if len(view) < total:
+                raise WireError("truncated history entries")
+            body = bytes(view[HIST_HDR_WIRE:total])
+            if kind == HIST_IDX:
+                out.append(HistoryIndex.decode_body(nonce, body))
+            else:
+                out.append(HistoryBatch.decode_body(nonce, body))
+            view = view[total:]
         else:
             raise WireError(f"unknown message kind {kind}")
     return out

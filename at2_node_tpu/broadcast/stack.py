@@ -63,10 +63,19 @@ from .messages import (
     READY,
     Attestation,
     ContentRequest,
+    HistoryBatch,
+    HistoryIndex,
+    HistoryIndexRequest,
+    HistoryRequest,
     Payload,
     WireError,
     parse_frame,
 )
+
+# Catchup-plane messages are control traffic for the node service (ledger
+# history catchup, ledger/history.py) — the broadcast stack just routes
+# them to the registered handler; they carry no broadcast state.
+_CATCHUP_KINDS = (HistoryIndexRequest, HistoryIndex, HistoryRequest, HistoryBatch)
 
 logger = logging.getLogger(__name__)
 
@@ -96,6 +105,11 @@ REQUEST_RETRY = 5.0
 # Max messages one worker drains from the inbox per iteration: the unit of
 # bulk verification (one verify_many call -> one slice of the TPU batch).
 WORKER_CHUNK = 256
+# Byte budget for undrained inbox frames. The inbox's 65536-entry bound
+# alone would admit ~1 TiB of parked 16 MiB frames from an authenticated
+# byzantine peer; 64 MiB is >4x the largest legitimate frame and hundreds
+# of typical attestation batches — overflow drops, like the entry cap.
+INBOX_MAX_BYTES = 64 * 1024 * 1024
 
 
 class _BoundedSet:
@@ -182,6 +196,13 @@ class Broadcast:
         self.delivered: asyncio.Queue = asyncio.Queue()
         self._slots: Dict[Slot, _SlotState] = {}
         self._inbox: asyncio.Queue = asyncio.Queue(maxsize=65536)
+        # The inbox holds RAW frames (parsed in the worker chunk stage),
+        # each up to transport MAX_FRAME (16 MiB) — so the entry-count
+        # bound alone would let an authenticated-but-byzantine peer (in
+        # model for BFT) park ~1 TiB of undrained bytes. Bound BYTES too:
+        # admission debits the budget, the worker credits it back on
+        # dequeue. Single-threaded (event loop) => plain int is race-free.
+        self._inbox_bytes = 0
         self._tasks: list = []
         # inflight verification dedup: messages identical to one already
         # being verified are coalesced instead of re-verified
@@ -191,6 +212,9 @@ class Broadcast:
         self._delivered_slots = _BoundedSet(DEDUP_CAP)
         # count of slots in _slots with delivered == False (the cap metric)
         self._undelivered = 0
+        # node-service hook for catchup-plane messages (sync callable
+        # (peer, msg) -> None); None drops them (a stack used standalone)
+        self.catchup_handler = None
         # observability counters (SURVEY.md §5: per-stage counters)
         self.stats = {
             "gossip_rx": 0,
@@ -229,11 +253,17 @@ class Broadcast:
         worker chunk stage (one native-ingest call per chunk when the C++
         library is available — frame parse + payload content hashes in
         one GIL-released pass). Drops (best-effort plane) when the inbox
-        is saturated rather than back-pressuring the socket."""
+        is saturated — by entry count OR byte budget — rather than
+        back-pressuring the socket."""
+        if self._inbox_bytes + len(frame) > INBOX_MAX_BYTES:
+            logger.warning("inbox byte budget exhausted; dropping frame")
+            return
         try:
             self._inbox.put_nowait((peer, frame))
         except asyncio.QueueFull:
             logger.warning("inbox overflow; dropping frame")
+        else:
+            self._inbox_bytes += len(frame)
 
     async def broadcast(self, payload: Payload) -> None:
         """Local submission (the gRPC SendAsset handler calls this —
@@ -276,6 +306,9 @@ class Broadcast:
                     chunk.append(self._inbox.get_nowait())
                 except asyncio.QueueEmpty:
                     break
+            for _, payload in chunk:
+                if isinstance(payload, (bytes, bytearray, memoryview)):
+                    self._inbox_bytes -= len(payload)
             try:
                 await self._process_chunk(self._parse_chunk(chunk))
             except Exception:
@@ -301,14 +334,16 @@ class Broadcast:
                 out.append((peer, item))
         if not frames:
             return out
-        from ..native import ingest_available, parse_frames_native
+        from ..native import ingest_ready_or_kick, parse_frames_native
 
         # The native call has fixed setup cost (ndarray staging, one
         # ctypes crossing); it wins when a chunk actually batched. Tiny
         # chunks — one frame trickling in on an idle net — stay on the
         # Python parser, which is faster below this threshold.
+        # ingest_ready_or_kick never builds: start() pre-builds off-loop,
+        # a stack used without start() must not run g++ on the event loop.
         total_bytes = sum(len(f) for f in frames)
-        if total_bytes >= 4096 and ingest_available():
+        if total_bytes >= 4096 and ingest_ready_or_kick():
             parsed, frame_ok = parse_frames_native(frames)
             for i, ok in enumerate(frame_ok):
                 if not ok:
@@ -348,6 +383,15 @@ class Broadcast:
                     actions.append((GOSSIP, msg))
             elif isinstance(msg, ContentRequest):
                 self._on_request(peer, msg)
+            elif isinstance(msg, _CATCHUP_KINDS):
+                # synchronous handler (service-side bookkeeping / replies
+                # via mesh.send); heavy work happens in the service's
+                # catchup task, never in this worker
+                if self.catchup_handler is not None and peer is not None:
+                    try:
+                        self.catchup_handler(peer, msg)
+                    except Exception:
+                        logger.exception("catchup handler error")
             else:
                 if self._pre_attestation(msg):
                     to_verify.append((msg.origin, msg.to_sign(), msg.signature))

@@ -95,14 +95,15 @@ class CpuVerifier:
         if n == 0:
             return []
 
-        from ..native import ingest_available, verify_bulk_native
+        from ..native import ingest_ready_or_kick, verify_bulk_native
 
         # The one-C-call path has fixed staging cost (ragged ndarray
         # packing, ctypes crossing) that only amortizes on real batches;
         # trickle-sized chunks stay on the slice path (measured on the
         # 4-node e2e config: the native call is a wash below ~32 items
-        # and LOSES below ~16).
-        if n >= 32 and ingest_available():
+        # and LOSES below ~16). ingest_ready_or_kick never builds — a
+        # verifier used without warmup must not run g++ on the event loop.
+        if n >= 32 and ingest_ready_or_kick():
             # thread fan-out capped at the REAL core count: executor
             # max_workers is an IO-sizing default (cpu+4) and oversubscribing
             # OpenSSL threads on small hosts costs more than it buys
@@ -304,12 +305,24 @@ class TpuBatchVerifier:
         loop = asyncio.get_running_loop()
         sinks: List[_ChunkSink] = []
         items = list(items) if not isinstance(items, (list, tuple)) else items
-        for i in range(0, n, self.batch_size):
-            chunk = items[i : i + self.batch_size]
-            await self._acquire(len(chunk))
-            sink = _ChunkSink(loop, len(chunk))
-            self._enqueue_chunk(chunk, sink)
-            sinks.append(sink)
+        try:
+            for i in range(0, n, self.batch_size):
+                chunk = items[i : i + self.batch_size]
+                await self._acquire(len(chunk))
+                sink = _ChunkSink(loop, len(chunk))
+                self._enqueue_chunk(chunk, sink)
+                sinks.append(sink)
+        except BaseException:
+            # close() landed between chunks: the already-enqueued sinks
+            # WILL be resolved (close fails queued entries; in-flight
+            # batches resolve via _complete) — consume those futures so
+            # their exceptions are retrieved and any completed chunk's
+            # results aren't silently dropped as un-awaited warnings
+            if sinks:
+                await asyncio.gather(
+                    *(s.future for s in sinks), return_exceptions=True
+                )
+            raise
         # gather (not sequential awaits): when an early chunk's dispatch
         # fails, every sink's exception is still retrieved — no
         # "exception was never retrieved" spam for the later chunks

@@ -67,6 +67,29 @@ class Accounts:
                 for user, (seq, bal) in data.items()
             }
 
+    def frontier_nowait(self) -> Dict[bytes, int]:
+        """Point-in-time {sender: last_sequence} map, lock-free.
+
+        Safe on the event loop: every mutation happens synchronously
+        inside a lock-held critical section on this same loop, so a
+        single synchronous read can never observe a torn update. Used by
+        the catchup plane, whose handlers run in broadcast workers and
+        must not await the actor lock. O(ledger) — hot paths that need a
+        single sender use :meth:`last_sequence_nowait` instead.
+        """
+        return {
+            user: a.last_sequence
+            for user, a in self._ledger.items()
+            if a.last_sequence > 0
+        }
+
+    def last_sequence_nowait(self, user: bytes) -> int:
+        """Single-sender lock-free read (same safety argument as
+        :meth:`frontier_nowait`); O(1) for the delivery drain's per-entry
+        staleness check."""
+        account = self._ledger.get(user)
+        return account.last_sequence if account is not None else 0
+
     async def get_balance(self, user: bytes) -> int:
         async with self._lock:
             account = self._ledger.get(user)

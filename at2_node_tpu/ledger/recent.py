@@ -62,6 +62,21 @@ class RecentTransactions:
                     tx.state = state
                     return
 
+    async def mark_failure_unless_success(
+        self, sender: bytes, sender_sequence: int
+    ) -> None:
+        """TTL marking for a stale (already-consumed-sequence) heap entry:
+        a catchup/delivery duplicate of a COMMITTED transfer must not flip
+        its twin's SUCCESS record, while a genuinely failed transfer (its
+        own debit consumed the sequence) still gets the reference's
+        FAILURE record (`/root/reference/src/bin/server/rpc.rs:183-193`)."""
+        async with self._lock:
+            for tx in reversed(self._ring):
+                if tx.sender_sequence == sender_sequence and tx.sender == sender:
+                    if tx.state is not TransactionState.SUCCESS:
+                        tx.state = TransactionState.FAILURE
+                    return
+
     async def export_state(self) -> list:
         """Snapshot for checkpointing (JSON-safe rows, oldest first)."""
         from ..types import rfc3339

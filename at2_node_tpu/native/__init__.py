@@ -8,11 +8,21 @@ via ctypes (no pybind11 in this image). Falls back to the pure-Python
 path transparently if compilation fails.
 """
 
-from .ingest import ingest_available, parse_frames_native, verify_bulk_native
+from .ingest import (
+    ingest_available,
+    ingest_ready,
+    ingest_ready_or_kick,
+    kick_ingest_build,
+    parse_frames_native,
+    verify_bulk_native,
+)
 from .prep import native_available, prep_batch_native
 
 __all__ = [
     "ingest_available",
+    "ingest_ready",
+    "ingest_ready_or_kick",
+    "kick_ingest_build",
     "native_available",
     "parse_frames_native",
     "prep_batch_native",

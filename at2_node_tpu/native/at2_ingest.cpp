@@ -19,11 +19,18 @@
 //    so verdicts are bit-identical with keys.verify_one.
 //
 // Wire layout parity (broadcast/messages.py, all integers LE):
-//   GOSSIP  = 0x01 | sender(32) seq(u32) recipient(32) amount(u64) sig(64)
-//   ECHO    = 0x02 | origin(32) sender(32) seq(u32) chash(32) sig(64)
-//   READY   = 0x03 | (same body as ECHO)
-//   REQUEST = 0x04 | sender(32) seq(u32) chash(32)
+//   GOSSIP       = 0x01 | sender(32) seq(u32) recipient(32) amount(u64) sig(64)
+//   ECHO         = 0x02 | origin(32) sender(32) seq(u32) chash(32) sig(64)
+//   READY        = 0x03 | (same body as ECHO)
+//   REQUEST      = 0x04 | sender(32) seq(u32) chash(32)
+//   HIST_IDX_REQ = 0x05 | nonce(u64)
+//   HIST_IDX     = 0x06 | nonce(u64) count(u32) count*(sender(32) seq(u32))
+//   HIST_REQ     = 0x07 | nonce(u64) sender(32) from(u32) to(u32)
+//   HIST_BATCH   = 0x08 | nonce(u64) count(u32) count*(140-byte GOSSIP body)
 // content_hash = SHA-256 over the 140-byte GOSSIP body (kind excluded).
+// Variable-length kinds (6, 8) don't fit a fixed row: their row stores the
+// body's (offset, length) into the caller's flat buffer and Python decodes
+// the slice — they are rare control traffic, not the hot path.
 
 #include <cstddef>
 #include <cstdint>
@@ -128,17 +135,41 @@ void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
 // ---------------- wire constants (must match broadcast/messages.py) ----
 
 constexpr uint8_t kGossip = 1, kEcho = 2, kReady = 3, kRequest = 4;
+constexpr uint8_t kHistIdxReq = 5, kHistIdx = 6, kHistReq = 7, kHistBatch = 8;
 constexpr size_t kPayloadWire = 1 + 140;
 constexpr size_t kAttestWire = 1 + 164;
 constexpr size_t kRequestWire = 1 + 68;
-constexpr size_t kMinWire = kRequestWire;  // smallest message on the wire
+constexpr size_t kHistIdxReqWire = 1 + 8;
+constexpr size_t kHistReqWire = 1 + 48;
+constexpr size_t kHistHdrWire = 1 + 12;  // nonce(u64) + count(u32)
+constexpr size_t kHistIdxEntry = 36;
+constexpr size_t kHistBatchEntry = 140;
+constexpr size_t kMinWire = kHistIdxReqWire;  // smallest message on the wire
+// A legitimate frame coalesces at most MAX_BATCH_MSGS = 1024 messages
+// (net/peers.py); 4x that is the malformed-frame bound. Without it a
+// frame dense with 9-byte messages forces a row allocation ~8x the frame
+// size and millions of Python objects downstream.
+constexpr int64_t kMaxMsgsPerFrame = 4096;
+
+inline uint32_t le32(const uint8_t* p) {
+  return uint32_t(p[0]) | (uint32_t(p[1]) << 8) | (uint32_t(p[2]) << 16) |
+         (uint32_t(p[3]) << 24);
+}
 
 // Output record: one fixed-stride row per message.
 //   byte 0            : kind (0 = row unused)
 //   GOSSIP  row [1..141): the 140-byte wire body, [141..173): content hash
 //   ECHO/READY [1..165): the 164-byte wire body
 //   REQUEST row [1..69) : the 68-byte wire body
+//   HIST_IDX_REQ [1..9) : the 8-byte wire body
+//   HIST_REQ  row [1..49): the 48-byte wire body
+//   HIST_IDX / HIST_BATCH [1..9): u64 LE body offset into `flat`,
+//                         [9..17): u64 LE body length (incl. the header)
 constexpr size_t kRowStride = 176;  // 173 rounded up for alignment
+
+inline void put_le64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = uint8_t(v >> (8 * i));
+}
 
 }  // namespace
 
@@ -166,13 +197,26 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
       if (kind == kGossip) wire = kPayloadWire;
       else if (kind == kEcho || kind == kReady) wire = kAttestWire;
       else if (kind == kRequest) wire = kRequestWire;
-      else { ok = false; break; }
+      else if (kind == kHistIdxReq) wire = kHistIdxReqWire;
+      else if (kind == kHistReq) wire = kHistReqWire;
+      else if (kind == kHistIdx || kind == kHistBatch) {
+        if (left < kHistHdrWire) { ok = false; break; }
+        uint64_t count = le32(p + 9);
+        size_t entry = (kind == kHistIdx) ? kHistIdxEntry : kHistBatchEntry;
+        wire = kHistHdrWire + size_t(count) * entry;  // < 2^40, no overflow
+      } else { ok = false; break; }
       if (left < wire) { ok = false; break; }
+      if (n_out - start >= kMaxMsgsPerFrame) { ok = false; break; }
       if (n_out >= cap) return -1;
       uint8_t* row = rows + n_out * kRowStride;
       row[0] = kind;
-      std::memcpy(row + 1, p + 1, wire - 1);
-      if (kind == kGossip) sha256(p + 1, 140, row + 141);
+      if (kind == kHistIdx || kind == kHistBatch) {
+        put_le64(row + 1, uint64_t(p + 1 - flat));
+        put_le64(row + 9, uint64_t(wire - 1));
+      } else {
+        std::memcpy(row + 1, p + 1, wire - 1);
+        if (kind == kGossip) sha256(p + 1, 140, row + 141);
+      }
       msg_frame[n_out] = uint32_t(f);
       n_out++;
       p += wire;

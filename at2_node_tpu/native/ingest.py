@@ -27,10 +27,19 @@ import numpy as np
 from ..broadcast.messages import (
     ECHO,
     GOSSIP,
+    HIST_BATCH,
+    HIST_IDX,
+    HIST_IDX_REQ,
+    HIST_REQ,
     READY,
     REQUEST,
+    _HIST_HDR,
     Attestation,
     ContentRequest,
+    HistoryBatch,
+    HistoryIndex,
+    HistoryIndexRequest,
+    HistoryRequest,
     Payload,
 )
 from ._build import U8P, U32P, U64P, load_lib, pack_ragged, ptr8
@@ -74,6 +83,45 @@ def ingest_available() -> bool:
     return _load() is not None
 
 
+def ingest_ready() -> bool:
+    """Non-BUILDING probe for hot paths: True only when the library load
+    already completed. `ingest_available` can run the first-use g++
+    compile (seconds, synchronous) — that must never happen on an event
+    loop inside a live worker chunk; Broadcast.start/warmup pre-build
+    off-loop, and anything used without warmup consults this instead and
+    kicks the build to a background thread via :func:`kick_ingest_build`."""
+    if os.environ.get("AT2_NO_NATIVE_INGEST"):
+        return False
+    return _lib is not None
+
+
+_build_kicked = False
+
+
+def kick_ingest_build() -> None:
+    """Start the build/load on a daemon thread if no one has yet, so a
+    verifier used without warmup converges to the native path after the
+    first few chunks instead of freezing the loop on chunk one."""
+    global _build_kicked
+    if _build_kicked or _tried:
+        return
+    _build_kicked = True
+    threading.Thread(
+        target=ingest_available, daemon=True, name="at2-ingest-build"
+    ).start()
+
+
+def ingest_ready_or_kick() -> bool:
+    """THE hot-path probe: True when the native path is usable right now;
+    otherwise kicks the background build (once) and returns False so the
+    caller takes the Python path this time. Keeps the
+    never-build-on-the-event-loop policy in one place."""
+    if ingest_ready():
+        return True
+    kick_ingest_build()
+    return False
+
+
 def parse_frames_native(frames: Sequence[bytes]):
     """Parse many frames in one native call.
 
@@ -85,23 +133,33 @@ def parse_frames_native(frames: Sequence[bytes]):
     assert lib is not None, "call ingest_available() first"
     flat, offsets = pack_ragged(frames)
     stride = int(lib.at2_ingest_row_stride())
-    # messages are >= min_wire bytes, so this cap bounds the row count
-    cap = int(flat.size // int(lib.at2_ingest_min_wire())) + len(frames) + 1
-    rows = np.zeros((cap, stride), dtype=np.uint8)
-    msg_frame = np.zeros(cap, dtype=np.uint32)
-    frame_ok = np.zeros(len(frames), dtype=np.uint8)
-    n = int(
-        lib.at2_parse_frames(
-            ptr8(flat),
-            offsets.ctypes.data_as(U64P),
-            len(frames),
-            ptr8(rows),
-            cap,
-            msg_frame.ctypes.data_as(U32P),
-            ptr8(frame_ok),
+    # Row capacity: size the buffer for the hot-path mix first (nothing on
+    # the wire smaller than a ContentRequest, 69 bytes); if a frame turns
+    # out to be dense with tiny catchup control messages (min_wire bytes
+    # each) the parser returns -1 and we retry once with the true bound —
+    # which the per-frame message cap (kMaxMsgsPerFrame; frames beyond it
+    # are malformed and drop whole) keeps proportional to the frame
+    # count, not the byte count.
+    per_frame_bound = len(frames) * 4096
+    for min_wire in (69, int(lib.at2_ingest_min_wire())):
+        cap = min(int(flat.size // min_wire), per_frame_bound) + len(frames) + 1
+        rows = np.zeros((cap, stride), dtype=np.uint8)
+        msg_frame = np.zeros(cap, dtype=np.uint32)
+        frame_ok = np.zeros(len(frames), dtype=np.uint8)
+        n = int(
+            lib.at2_parse_frames(
+                ptr8(flat),
+                offsets.ctypes.data_as(U64P),
+                len(frames),
+                ptr8(rows),
+                cap,
+                msg_frame.ctypes.data_as(U32P),
+                ptr8(frame_ok),
+            )
         )
-    )
-    if n < 0:  # cannot happen given the bound; survive `python -O` anyway
+        if n >= 0:
+            break
+    if n < 0:  # cannot happen given the final bound; survive `python -O`
         raise RuntimeError("native parse overflowed its row capacity")
 
     # Object building reuses the same Struct-based decode_body paths the
@@ -124,6 +182,20 @@ def parse_frames_native(frames: Sequence[bytes]):
             )
         elif kind == REQUEST:
             msg = ContentRequest.decode_body(row_bytes[base + 1 : base + 69])
+        elif kind == HIST_IDX_REQ:
+            msg = HistoryIndexRequest.decode_body(row_bytes[base + 1 : base + 9])
+        elif kind == HIST_REQ:
+            msg = HistoryRequest.decode_body(row_bytes[base + 1 : base + 49])
+        elif kind in (HIST_IDX, HIST_BATCH):
+            # variable-length rows carry (offset, length) into `flat`
+            off = int.from_bytes(row_bytes[base + 1 : base + 9], "little")
+            ln = int.from_bytes(row_bytes[base + 9 : base + 17], "little")
+            body = flat[off : off + ln].tobytes()
+            nonce, _count = _HIST_HDR.unpack_from(body)
+            if kind == HIST_IDX:
+                msg = HistoryIndex.decode_body(nonce, body[_HIST_HDR.size :])
+            else:
+                msg = HistoryBatch.decode_body(nonce, body[_HIST_HDR.size :])
         else:  # pragma: no cover - the C side never emits other kinds
             continue
         out.append((frame_idx[i], msg))

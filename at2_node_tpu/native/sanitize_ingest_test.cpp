@@ -62,6 +62,19 @@ int main() {
   add_frame({0xff, 0x01, 0x02});            // unknown kind
   add_frame(std::vector<uint8_t>(gossip.begin(), gossip.end() - 1));  // short
   add_frame({1});                           // kind byte only
+  // catchup plane: HIST_IDX_REQ, HIST_BATCH carrying 2 payload bodies,
+  // a HIST_BATCH whose count overruns the bytes (whole frame drops),
+  // and a truncated HIST_IDX header
+  add_frame({5, 1, 2, 3, 4, 5, 6, 7, 8});
+  {
+    std::vector<uint8_t> batch{8, 9, 9, 9, 9, 9, 9, 9, 9, 2, 0, 0, 0};
+    for (int i = 0; i < 280; i++) batch.push_back(next());
+    add_frame(batch);
+    std::vector<uint8_t> overrun{8, 9, 9, 9, 9, 9, 9, 9, 9, 3, 0, 0, 0};
+    for (int i = 0; i < 280; i++) overrun.push_back(next());
+    add_frame(overrun);
+  }
+  add_frame({6, 1, 2, 3});                  // truncated HIST_IDX header
 
   int64_t n_frames = int64_t(offsets.size()) - 1;
   int64_t cap = 64;
@@ -71,8 +84,8 @@ int main() {
   int64_t n = at2_parse_frames(flat.data(), offsets.data(), n_frames,
                                rows.data(), cap, msg_frame.data(),
                                frame_ok.data());
-  const uint8_t want_ok[8] = {1, 1, 1, 1, 1, 0, 0, 0};
-  if (n != 5 || std::memcmp(frame_ok.data(), want_ok, 8) != 0) {
+  const uint8_t want_ok[12] = {1, 1, 1, 1, 1, 0, 0, 0, 1, 1, 0, 0};
+  if (n != 7 || std::memcmp(frame_ok.data(), want_ok, 12) != 0) {
     std::fprintf(stderr, "FAIL: parse results n=%lld\n", (long long)n);
     return 1;
   }

@@ -351,12 +351,19 @@ class PortMux:
             return False
 
         # HTTP/1.1 defaults to keep-alive; 1.0 only opts in; either side
-        # can force close
-        connection = headers.get("connection", "").lower()
+        # can force close. Connection is a comma-separated token list
+        # (RFC 9110 §7.6.1) — compare whole tokens, not substrings, so a
+        # token that merely CONTAINS "close"/"keep-alive" can't
+        # misclassify the connection.
+        conn_tokens = {
+            t.strip()
+            for t in headers.get("connection", "").lower().split(",")
+            if t.strip()
+        }
         keep = allow_keep and (
-            "close" not in connection
+            "close" not in conn_tokens
             if version.strip().upper() == "HTTP/1.1"
-            else "keep-alive" in connection
+            else "keep-alive" in conn_tokens
         )
 
         if method.upper() == "OPTIONS":

@@ -23,7 +23,10 @@ Two conscious additions over the reference schema:
 * an optional `[checkpoint]` table — `path` (ledger snapshot file;
   restored on start when present) and `interval` (seconds between
   snapshots) — implements the reference's open "store state on disk to
-  restart after crash" roadmap item (`/root/reference/README.md:52`).
+  restart after crash" roadmap item (`/root/reference/README.md:52`);
+* an optional `[catchup]` table — `enabled`, `quorum`, `after`, `window`,
+  `history_cap` (see `CatchupConfig`) — implements the reference's open
+  "catchup mechanism" roadmap item (`/root/reference/README.md:53`).
 """
 
 from __future__ import annotations
@@ -68,6 +71,24 @@ class CheckpointConfig:
 
 
 @dataclass
+class CatchupConfig:
+    """Ledger-history catchup (ledger/history.py): a rejoining node pulls
+    quorum-confirmed committed history from peers and replays it through
+    the sequence gate. ``quorum`` = peers that must agree on a slot's
+    content hash before it is applied (0 → the node's ready threshold;
+    set >= f+1 for byzantine tolerance). ``after`` = seconds a sequence
+    gap must persist in the retry heap before a catchup session starts.
+    ``window`` = seconds a session waits for index/batch responses.
+    ``history_cap`` = committed payloads retained for serving peers."""
+
+    enabled: bool = True
+    quorum: int = 0
+    after: float = 3.0
+    window: float = 1.0
+    history_cap: int = 1 << 17
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -79,6 +100,7 @@ class Config:
         default_factory=ObservabilityConfig
     )
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    catchup: CatchupConfig = field(default_factory=CatchupConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -120,6 +142,17 @@ class Config:
                 f'path = "{self.checkpoint.path}"',
                 f"interval = {self.checkpoint.interval}",
             ]
+        cu = self.catchup
+        if cu != CatchupConfig():
+            lines += [
+                "",
+                "[catchup]",
+                f"enabled = {'true' if cu.enabled else 'false'}",
+                f"quorum = {cu.quorum}",
+                f"after = {cu.after}",
+                f"window = {cu.window}",
+                f"history_cap = {cu.history_cap}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -136,6 +169,7 @@ class Config:
         verifier = VerifierConfig(**doc.get("verifier", {}))
         observability = ObservabilityConfig(**doc.get("observability", {}))
         ckpt = CheckpointConfig(**doc.get("checkpoint", {}))
+        catchup = CatchupConfig(**doc.get("catchup", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -152,6 +186,7 @@ class Config:
             verifier=verifier,
             observability=observability,
             checkpoint=ckpt,
+            catchup=catchup,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

@@ -27,18 +27,26 @@ from __future__ import annotations
 import asyncio
 import heapq
 import logging
+import secrets
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import grpc
 
-from ..broadcast.messages import Payload
+from ..broadcast.messages import (
+    HistoryBatch,
+    HistoryIndex,
+    HistoryIndexRequest,
+    HistoryRequest,
+    Payload,
+)
 from ..broadcast.stack import Broadcast
 from ..crypto.verifier import Verifier
 from ..ledger import checkpoint as ckpt
+from ..ledger import history as hist
 from ..ledger.accounts import AccountModificationError, Accounts
 from ..ledger.recent import RecentTransactions
-from ..net.peers import Mesh
+from ..net.peers import Mesh, Peer
 from ..net.webmux import PortMux
 from ..proto import at2_pb2 as pb
 from ..proto.rpc import At2Servicer, add_to_server
@@ -54,6 +62,46 @@ logger = logging.getLogger(__name__)
 stats_logger = logging.getLogger("at2_node_tpu.stats")
 
 TRANSACTION_TTL = 60.0  # seconds, rpc.rs:35
+
+# A catchup session holds at most this many candidate payloads (bounds a
+# byzantine peer flooding HistoryBatch junk into an open session).
+MAX_SESSION_PAYLOADS = 1 << 17
+
+# Serving-side catchup budgets, per peer per second: a 9-byte
+# HistoryIndexRequest triggers an O(ledger) frontier snapshot and a
+# response of up to megabytes, and a 49-byte HistoryRequest up to
+# MAX_RANGE payload encodes — without a budget an authenticated byzantine
+# peer has a huge amplification lever into the broadcast workers. A real
+# catchup session needs ONE index and one range request per gapped
+# sender; the budgets are far above that and refill every second, so a
+# throttled legitimate requester just retries next session.
+SERVE_IDX_PER_SEC = 4
+SERVE_ROWS_PER_SEC = 4 * 4096
+
+
+class _CatchupSession:
+    """In-flight catchup state: peers' frontiers and served payloads,
+    grouped for quorum confirmation. Filled synchronously by the
+    broadcast workers' handler; consumed by `Service._catchup_once`."""
+
+    __slots__ = ("nonce", "per_peer_cap", "indexes", "votes", "payloads",
+                 "stored_by_peer")
+
+    def __init__(self, nonce: int, n_peers: int) -> None:
+        self.nonce = nonce
+        # The storage cap is per SENDING peer: one byzantine peer
+        # flooding junk payloads exhausts only its own share and can
+        # neither evict nor block honest peers' copies. Vote accrual on
+        # already-stored keys is never capped (votes are one set entry,
+        # and blocking them would let the flooder starve quorum).
+        self.per_peer_cap = max(1, MAX_SESSION_PAYLOADS // max(1, n_peers))
+        # peer sign key -> ((sender, last_seq), ...)
+        self.indexes: Dict[bytes, tuple] = {}
+        # ((sender, seq), content_hash) -> peer sign keys vouching for it
+        self.votes: Dict[tuple, Set[bytes]] = {}
+        # ((sender, seq), content_hash) -> the payload itself
+        self.payloads: Dict[tuple, Payload] = {}
+        self.stored_by_peer: Dict[bytes, int] = {}
 
 
 def _enable_stats_logging() -> None:
@@ -88,6 +136,27 @@ class Service(At2Servicer):
         # leftovers: (key, arrival, tiebreak, payload) carried across batches
         self._heap: List[tuple] = []
         self._push_count = 0  # monotonic heap tiebreaker
+        self._heap_keys: set = set()  # keys currently in _heap (dedup)
+        # the delivery loop, catchup task, and close() all drain the heap;
+        # serialize the fixpoint passes so two drains never interleave
+        self._drain_lock = asyncio.Lock()
+        self._closing = False
+        # ledger-history catchup (the reference's open roadmap item,
+        # README.md:53): serving store + at most one in-flight session
+        self.history = hist.CommittedHistory(config.catchup.history_cap)
+        self._catchup_session: Optional[_CatchupSession] = None
+        self._catchup_task: Optional[asyncio.Task] = None
+        self.catchup_stats = {
+            "catchup_sessions": 0,
+            "catchup_applied": 0,
+            "catchup_idx_req_rx": 0,
+            "catchup_hist_req_rx": 0,
+            "catchup_served": 0,
+            "catchup_throttled": 0,
+        }
+        # per-(peer, kind) serving budgets: [window_start, used]
+        self._serve_budget: Dict[tuple, list] = {}
+        self._idx_serve_offset = 0  # rotating HistoryIndex window
 
     # -- lifecycle --------------------------------------------------------
 
@@ -142,9 +211,20 @@ class Service(At2Servicer):
                 echo_threshold=config.echo_threshold,
                 ready_threshold=config.ready_threshold,
             )
+            service.broadcast.catchup_handler = service._on_catchup
             await service.mesh.start()
             await service.broadcast.start()
             service._delivery_task = asyncio.create_task(service._delivery_loop())
+
+            # Rejoin catchup: a node starting into an existing network may
+            # have missed committed history (crash without checkpoint, or
+            # checkpoint lag); one session shortly after the mesh dials
+            # re-converges the ledger without waiting for new traffic to
+            # expose the gap.
+            if config.catchup.enabled and service.mesh.peers:
+                service._catchup_task = asyncio.create_task(
+                    service._catchup_runner(initial_delay=config.catchup.after)
+                )
 
             # interval <= 0 means snapshot-on-shutdown only (consistent with
             # the observability convention where 0 disables the periodic task)
@@ -203,6 +283,13 @@ class Service(At2Servicer):
         await self._grpc_server.wait_for_termination()
 
     async def close(self) -> None:
+        self._closing = True
+        if self._catchup_task is not None:
+            self._catchup_task.cancel()
+            try:
+                await self._catchup_task
+            except asyncio.CancelledError:
+                pass
         if self._profiling:
             import jax
 
@@ -284,6 +371,8 @@ class Service(At2Servicer):
         """One structured stats record: broadcast per-stage counters +
         verifier batch metrics + commit progress (SURVEY.md §5)."""
         out = {"committed": self.committed, "pending": len(self._heap)}
+        out.update(self.catchup_stats)
+        out["history_retained"] = len(self.history)
         if self.broadcast is not None:
             out.update(self.broadcast.stats)
         if self.verifier is not None:
@@ -314,9 +403,15 @@ class Service(At2Servicer):
 
     def _push_pending(self, p: Payload, now: float) -> None:
         """Push one delivered payload onto the retry heap — the ONE place
-        the heap key is built (delivery loop and shutdown drain share it:
-        the commit order must not depend on which path enqueued)."""
+        the heap key is built (delivery loop, catchup, and shutdown drain
+        share it: the commit order must not depend on which path
+        enqueued). Exact duplicates already pending are skipped: catchup
+        can race normal delivery of the same slot, and the loser of the
+        sequence gate would otherwise park in the heap forever."""
         key = (p.sequence, p.sender, p.transaction.recipient, p.transaction.amount)
+        if key in self._heap_keys:
+            return
+        self._heap_keys.add(key)
         self._push_count += 1
         heapq.heappush(self._heap, (key, now, self._push_count, p))
 
@@ -339,18 +434,49 @@ class Service(At2Servicer):
         # Mirrors rpc.rs:176-208: keep passing over the (sorted) pending
         # set while progress is made; retry only AccountModification
         # errors so a sequence gap fills once its predecessor lands.
-        pending = self._heap
+        async with self._drain_lock:
+            await self._drain_to_fixpoint_locked()
+
+    async def _drain_to_fixpoint_locked(self) -> None:
         while True:
-            before = len(pending)
+            # Take ownership of everything currently pending; the loop
+            # body awaits, and _push_pending (delivery loop, catchup) runs
+            # WITHOUT the drain lock — concurrent pushes land in the fresh
+            # self._heap and are picked up by the next pass instead of
+            # mutating the list this pass is iterating (a heappush mid-
+            # iteration could sift an entry behind the iterator, and the
+            # end-of-pass rebuild would silently discard it forever).
+            batch = self._heap
+            if not batch:
+                break
+            self._heap = []
+            before = len(batch)
             retry: List[tuple] = []
-            pending.sort()
-            for key, added, tiebreak, payload in pending:
+            batch.sort()
+            for key, added, tiebreak, payload in batch:
+                # An already-consumed sequence can never commit (the gate
+                # admits exactly last+1 and last only grows); keep it
+                # retrying until the reference's TTL so the ring records
+                # stay bit-identical with the reference, then drop it
+                # instead of parking it forever.
+                stale = payload.sequence <= self.accounts.last_sequence_nowait(
+                    payload.sender
+                )
                 if time.monotonic() - added > TRANSACTION_TTL:
                     logger.warning(
                         "transaction timed out: (%s, %d)",
                         payload.sender.hex()[:16],
                         payload.sequence,
                     )
+                    if stale:
+                        # catchup/delivery duplicate of a committed slot,
+                        # or a transfer whose own failed debit consumed
+                        # the sequence: FAILURE-mark the latter, never
+                        # flip a committed twin's SUCCESS, and drop
+                        await self.recent.mark_failure_unless_success(
+                            payload.sender, payload.sequence
+                        )
+                        continue
                     await self.recent.update(
                         payload.sender, payload.sequence, TransactionState.FAILURE
                     )
@@ -368,10 +494,34 @@ class Service(At2Servicer):
                     retry.append((key, added, tiebreak, payload))
                 except Exception as exc:
                     logger.warning("dropping bad payload: %s", exc)
-            pending[:] = retry
-            heapq.heapify(pending)
-            if not pending or len(pending) >= before:
-                return
+            # merge the leftovers with anything that arrived mid-pass; no
+            # awaits between here and the key rebuild, so the set and the
+            # heap cannot diverge
+            arrivals = len(self._heap)
+            self._heap.extend(retry)
+            heapq.heapify(self._heap)
+            self._heap_keys = {entry[0] for entry in self._heap}
+            progressed = len(retry) < before
+            if not self._heap or not (progressed or arrivals):
+                break
+        # Anything still pending after a fixpoint pass is gap-blocked: its
+        # predecessor is not in flight anywhere local, so if it doesn't
+        # resolve within cfg.after (the runner's initial delay), it was
+        # committed network-wide while this node was away — pull it from
+        # peers. The kick is single-flight and the runner paces itself,
+        # so kicking on every drain with leftovers is cheap; kicking ONLY
+        # when entries are already old would miss gaps entirely (drains
+        # run on delivery, and a quiet net delivers nothing after the
+        # gapped payload — the age condition would never be re-checked).
+        cfg = self.config.catchup
+        if (
+            cfg.enabled
+            and self._heap
+            and not self._closing
+            and self.mesh is not None
+            and self.mesh.peers
+        ):
+            self._kick_catchup()
 
     async def _process_payload(self, payload: Payload) -> None:
         # rpc.rs:213-237: commit to the ledger, then flip the ring entry.
@@ -390,6 +540,234 @@ class Service(At2Servicer):
             payload.sender, payload.sequence, TransactionState.SUCCESS
         )
         self.committed += 1
+        # retain for peers' ledger catchup (ledger/history.py)
+        self.history.record(payload)
+
+    # -- ledger-history catchup ------------------------------------------
+    #
+    # The reference's open "catchup mechanism" roadmap item
+    # (/root/reference/README.md:53). Protocol (messages in
+    # broadcast/messages.py, serving store in ledger/history.py):
+    #
+    #   1. broadcast HistoryIndexRequest(nonce); peers answer with their
+    #      commit frontier (sender -> last committed sequence);
+    #   2. for every sender some peer reports ahead of us, broadcast
+    #      HistoryRequest for the missing range; peers serve
+    #      HistoryBatch from their bounded history stores;
+    #   3. apply a slot only when `quorum` distinct peers returned the
+    #      same content hash for it (>= f+1 peers means at least one
+    #      correct peer vouches the content was committed — and sieve
+    #      guarantees committed content is unique per slot) AND the
+    #      client signature verifies; then replay through the normal
+    #      sequence gate, which makes the whole path idempotent.
+    #
+    # Snapshot transfer would be unsound here: in a consensus-free ledger
+    # a balance is a function of full history (credits don't bump the
+    # recipient's sequence), so point-in-time (sequence, balance) pairs
+    # from different peers cannot be safely reconciled. Replaying signed,
+    # quorum-confirmed history can, deterministically.
+
+    def _catchup_quorum(self, n_peers: int) -> int:
+        cfg = self.config.catchup
+        quorum = cfg.quorum
+        if quorum <= 0:
+            quorum = (
+                self.config.ready_threshold
+                if self.config.ready_threshold is not None
+                else n_peers
+            )
+        return max(1, min(quorum, n_peers))
+
+    def _serve_allow(self, peer: Peer, kind: str, cost: int, cap: int) -> bool:
+        """1-second token window per (peer, kind); drops beyond the cap
+        (the requester's session loop simply retries next second)."""
+        now = time.monotonic()
+        budget = self._serve_budget.setdefault(
+            (peer.sign_public, kind), [now, 0]
+        )
+        if now - budget[0] >= 1.0:
+            budget[0] = now
+            budget[1] = 0
+        if budget[1] + cost > cap:
+            self.catchup_stats["catchup_throttled"] += 1
+            return False
+        budget[1] += cost
+        return True
+
+    def _on_catchup(self, peer: Peer, msg) -> None:
+        """Broadcast-worker hook (synchronous): serve peers' catchup
+        requests and collect responses for our own session."""
+        if isinstance(msg, HistoryIndexRequest):
+            self.catchup_stats["catchup_idx_req_rx"] += 1
+            if not self._serve_allow(peer, "idx", 1, SERVE_IDX_PER_SEC):
+                return
+            entries = list(self.accounts.frontier_nowait().items())
+            if len(entries) > hist.MAX_IDX_ENTRIES:
+                # rotate the served window across requests: a fixed
+                # first-N slice (dict insertion order) would make senders
+                # past the cap permanently invisible to every requester —
+                # rotation guarantees coverage within ceil(N/cap) sessions
+                start = self._idx_serve_offset % len(entries)
+                self._idx_serve_offset = start + hist.MAX_IDX_ENTRIES
+                end = start + hist.MAX_IDX_ENTRIES
+                entries = entries[start:end] + entries[: max(0, end - len(entries))]
+                logger.warning(
+                    "history index truncated to %d entries (rotating window)",
+                    hist.MAX_IDX_ENTRIES,
+                )
+            self.mesh.send(peer, HistoryIndex(msg.nonce, tuple(entries)).encode())
+        elif isinstance(msg, HistoryRequest):
+            self.catchup_stats["catchup_hist_req_rx"] += 1
+            # budget BEFORE the store lookup, charged at the clamped
+            # request size: the O(range) work is the amplification lever,
+            # so a throttled request must cost nothing (over-charging a
+            # partially-retained range is the cheap, safe side)
+            cost = min(max(msg.to_seq - msg.from_seq + 1, 0), hist.MAX_RANGE)
+            if cost == 0 or not self._serve_allow(
+                peer, "rows", cost, SERVE_ROWS_PER_SEC
+            ):
+                return
+            payloads = self.history.get_range(msg.sender, msg.from_seq, msg.to_seq)
+            for i in range(0, len(payloads), hist.MAX_BATCH):
+                chunk = tuple(payloads[i : i + hist.MAX_BATCH])
+                self.mesh.send(peer, HistoryBatch(msg.nonce, chunk).encode())
+            self.catchup_stats["catchup_served"] += len(payloads)
+        elif isinstance(msg, HistoryIndex):
+            session = self._catchup_session
+            if session is not None and msg.nonce == session.nonce:
+                session.indexes[peer.sign_public] = msg.entries
+        elif isinstance(msg, HistoryBatch):
+            session = self._catchup_session
+            if session is not None and msg.nonce == session.nonce:
+                stored = session.stored_by_peer.get(peer.sign_public, 0)
+                for p in msg.payloads:
+                    vote_key = ((p.sender, p.sequence), p.content_hash())
+                    if vote_key in session.payloads:
+                        # vote accrual is never capped (see _CatchupSession)
+                        session.votes[vote_key].add(peer.sign_public)
+                        continue
+                    if stored >= session.per_peer_cap:
+                        logger.warning(
+                            "catchup payload cap reached for peer %s",
+                            peer.address,
+                        )
+                        break
+                    stored += 1
+                    session.votes.setdefault(vote_key, set()).add(
+                        peer.sign_public
+                    )
+                    session.payloads[vote_key] = p
+                session.stored_by_peer[peer.sign_public] = stored
+
+    def _kick_catchup(self) -> None:
+        if self._catchup_task is None or self._catchup_task.done():
+            # the initial delay gives a transient gap (predecessor still
+            # in flight through the broadcast) time to resolve without a
+            # session, and paces back-to-back kicks
+            self._catchup_task = asyncio.create_task(
+                self._catchup_runner(initial_delay=self.config.catchup.after)
+            )
+
+    # Sessions that heard from no peer retry at least this many times:
+    # right after a restart, peers' redial backoff (net/peers.py, capped
+    # at 5s) can delay their replies past several session windows.
+    _CATCHUP_MIN_ATTEMPTS = 8
+
+    async def _catchup_runner(self, initial_delay: float = 0.0) -> None:
+        """Run catchup sessions until the ledger is caught up: no stale
+        sequence gap remains AND at least one peer has answered (or the
+        attempt budget for unanswered sessions is spent)."""
+        cfg = self.config.catchup
+        if initial_delay:
+            await asyncio.sleep(initial_delay)
+        attempts = 0
+        try:
+            while not self._closing:
+                responses, applied = await self._catchup_once()
+                attempts += 1
+                now = time.monotonic()
+                gap_remains = any(
+                    now - entry[1] > cfg.after for entry in self._heap
+                )
+                if applied == 0 and not gap_remains and (
+                    responses > 0 or attempts >= self._CATCHUP_MIN_ATTEMPTS
+                ):
+                    return
+                if applied == 0 and gap_remains:
+                    logger.log(
+                        logging.WARNING if attempts <= 3 else logging.DEBUG,
+                        "catchup made no progress (attempt %d, %d peers "
+                        "answered); gap persists",
+                        attempts,
+                        responses,
+                    )
+                await asyncio.sleep(cfg.after)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("catchup runner failed")
+
+    async def _catchup_once(self) -> Tuple[int, int]:
+        """One catchup session; returns (peer index responses, applied)."""
+        cfg = self.config.catchup
+        peers = self.mesh.peers if self.mesh is not None else []
+        if not peers or self._catchup_session is not None:
+            return 0, 0
+        quorum = self._catchup_quorum(len(peers))
+        session = _CatchupSession(secrets.randbits(64), len(peers))
+        self._catchup_session = session
+        self.catchup_stats["catchup_sessions"] += 1
+        try:
+            self.mesh.broadcast(HistoryIndexRequest(session.nonce).encode())
+            await asyncio.sleep(cfg.window)
+            responses = len(session.indexes)
+            local = self.accounts.frontier_nowait()
+            needed: Dict[bytes, int] = {}
+            for frontier in session.indexes.values():
+                for sender, seq in frontier:
+                    if seq > local.get(sender, 0) and seq > needed.get(sender, 0):
+                        needed[sender] = seq
+            if not needed:
+                return responses, 0
+            for sender, top in needed.items():
+                lo = local.get(sender, 0) + 1
+                self.mesh.broadcast(
+                    HistoryRequest(session.nonce, sender, lo, top).encode()
+                )
+            await asyncio.sleep(cfg.window)
+            candidates = [
+                payload
+                for vote_key, payload in session.payloads.items()
+                if len(session.votes.get(vote_key, ())) >= quorum
+            ]
+            if not candidates:
+                return responses, 0
+            results = await self.verifier.verify_many(
+                [
+                    (p.sender, p.transaction.signing_bytes(), p.signature)
+                    for p in candidates
+                ]
+            )
+            now = time.monotonic()
+            frontier = self.accounts.frontier_nowait()
+            applied = 0
+            for p, ok in zip(candidates, results):
+                if ok and p.sequence > frontier.get(p.sender, 0):
+                    self._push_pending(p, now)
+                    applied += 1
+                elif not ok:
+                    logger.warning(
+                        "catchup payload failed signature check: (%s, %d)",
+                        p.sender.hex()[:16],
+                        p.sequence,
+                    )
+            if applied:
+                self.catchup_stats["catchup_applied"] += applied
+                logger.info("catchup applied %d historical payloads", applied)
+                await self._drain_to_fixpoint()
+            return responses, applied
+        finally:
+            self._catchup_session = None
 
     # -- gRPC handlers (rpc.rs:256-344) ----------------------------------
 

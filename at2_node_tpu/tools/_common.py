@@ -3,11 +3,28 @@
 from __future__ import annotations
 
 import itertools
+import os
 from typing import Iterator, List
 
 from ..crypto.keys import ExchangeKeyPair, SignKeyPair
 from ..net.peers import Peer
 from ..node.config import Config
+
+
+def host_context() -> dict:
+    """The ONE statement of this host's measurement ceiling, embedded by
+    every tool artifact (e2e_bench / scale_demo / aggregate_bench) so a
+    reader can't mistake harness floors for design ceilings."""
+    return {
+        "cpus": os.cpu_count(),
+        "note": (
+            "all servers, clients, load generators, and the XLA runtime "
+            "share this host's core(s); absolute tx/s figures on a "
+            "1-core VM are harness floors, not design ceilings — "
+            "cross-config DELTAS and device-side rates are the signal. "
+            "Run-to-run noise on this class of host is ~±10%."
+        ),
+    }
 
 
 def make_net_configs(

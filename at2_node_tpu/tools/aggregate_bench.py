@@ -118,8 +118,11 @@ def main(argv=None) -> int:
         winner = "rlc_aggregate"
     else:
         winner = "inconclusive"
+    from ._common import host_context
+
     artifact = {
         "config": "BASELINE-4: n=64 quorum-certificate aggregate verify",
+        "host_context": host_context(),
         "n": args.n,
         "per_sig_kernel": per_sig,
         "rlc_aggregate": aggregate,

@@ -195,9 +195,11 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
+    from ._common import host_context
+
     artifact = {
         "config": "BASELINE-1/3: 4-node net under send-asset load",
-        "host_cpus": os.cpu_count(),
+        "host_context": host_context(),
         "target_tx_per_sec": 10_000,
     }
     if not args.skip_cpu:

@@ -164,7 +164,12 @@ def main(argv=None) -> int:
         f = (args.nodes - 1) // 3
         threshold = 2 * f + 1
 
-    artifact = {"config": "BASELINE-5: v5e-8 pool behind 32 nodes, 1M-tx replay"}
+    from ._common import host_context
+
+    artifact = {
+        "config": "BASELINE-5: v5e-8 pool behind 32 nodes, 1M-tx replay",
+        "host_context": host_context(),
+    }
     if not args.skip_net:
         artifact["net"] = asyncio.run(
             _phase_net(

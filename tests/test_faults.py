@@ -36,7 +36,7 @@ from at2_node_tpu.broadcast.messages import Payload, parse_frame
 from at2_node_tpu.client import Client
 from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
 from at2_node_tpu.net.peers import Peer
-from at2_node_tpu.node.config import CheckpointConfig, Config
+from at2_node_tpu.node.config import CatchupConfig, CheckpointConfig, Config
 from at2_node_tpu.node.service import Service
 
 TICK = 0.1
@@ -81,8 +81,15 @@ class TestKillRestartRedial:
     async def test_node_killed_and_restarted_reconverges(self):
         # f=1-tolerant thresholds: with one node down the other two can
         # still commit (default reference thresholds are n_peers, which
-        # has zero fault tolerance — the knobs exist for exactly this)
-        cfgs = make_configs(3, echo_threshold=1, ready_threshold=1)
+        # has zero fault tolerance — the knobs exist for exactly this).
+        # Catchup quorum 2 = BOTH survivors must agree on each historical
+        # slot's content before the restarted node applies it.
+        cfgs = make_configs(
+            3,
+            echo_threshold=1,
+            ready_threshold=1,
+            catchup=CatchupConfig(quorum=2, after=0.5, window=0.3),
+        )
         services = [await Service.start(c) for c in cfgs]
         sender = SignKeyPair.random()
         recipient = SignKeyPair.random().public
@@ -104,35 +111,38 @@ class TestKillRestartRedial:
                     what="tx2 on survivors",
                 )
 
-                # restart node 2 on the same addresses; peers redial it
+                # restart node 2 on the same addresses; peers redial it.
+                # It missed seq 1-2 entirely (no checkpoint — full state
+                # loss): tx3 parks on its sequence gate until the ledger
+                # catchup pulls the missed history from the survivors and
+                # replays it — then ALL THREE nodes commit everything.
                 services[2] = await Service.start(cfgs[2])
                 await client.send_asset(sender, 3, recipient, 10)
                 await wait_until(
-                    lambda: _committed_on(
-                        [services[0], services[1], services[2]],
-                        3,
-                        sender.public,
-                        # the restarted node missed seq 1-2 entirely, so
-                        # its gate holds tx3 in the retry heap; what it
-                        # MUST show is the tx arriving over the redialed
-                        # connections (delivery), not the commit
-                        delivered_only=[2],
-                    ),
-                    what="tx3 after restart",
+                    lambda: _committed_on(services, 3, sender.public),
+                    what="full commit parity after restart",
                 )
-            # the restarted node's broadcast saw tx3 via redialed links
+            # the restarted node's broadcast saw tx3 via redialed links,
+            # recovered seq 1-2 via the catchup protocol, and its ledger
+            # fully re-converged
             assert services[2].broadcast.stats["delivered"] >= 1
+            assert services[2].catchup_stats["catchup_applied"] >= 2
+            for s in services:
+                assert await s.accounts.get_balance(sender.public) == FAUCET - 30
+                assert await s.accounts.get_balance(recipient) == FAUCET + 30
+            # and the survivors actually served it from their history
+            served = sum(
+                s.catchup_stats["catchup_served"] for s in services[:2]
+            )
+            assert served >= 2
         finally:
             for s in services:
                 await s.close()
 
 
-async def _committed_on(services, seq, sender_pub, delivered_only=()):
-    for i, s in enumerate(services):
-        if i in delivered_only:
-            if s.broadcast.stats["delivered"] < 1:
-                return False
-        elif await s.accounts.get_last_sequence(sender_pub) < seq:
+async def _committed_on(services, seq, sender_pub):
+    for s in services:
+        if await s.accounts.get_last_sequence(sender_pub) < seq:
             return False
     return True
 

@@ -7,7 +7,13 @@ Real multi-host hardware is not available in CI; what IS testable:
 * a 1-process distributed runtime (jax.distributed with
   num_processes=1, the degenerate but fully real code path) comes up in
   a subprocess, reports a coherent topology, and the sharded verifier
-  pool works over the resulting global mesh.
+  pool works over the resulting global mesh;
+* a REAL 2-process runtime (coordinator + worker over loopback, 4
+  virtual CPU devices each): global devices = 2x local, pool meshes stay
+  process-local (the multihost.py scaling model's load-bearing claim),
+  and single-controller SPMD programs — a psum reduction in the fast
+  tier, the full sharded ed25519 verify in the slow tier — span both
+  processes' devices.
 """
 
 import os
@@ -75,6 +81,139 @@ print("MULTIHOST_OK", info["process_count"], info["global_devices"])
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "MULTIHOST_OK 1 4" in proc.stdout, proc.stdout
+
+
+_TWO_PROC_PREAMBLE = """
+import os, sys
+sys.path.insert(0, @REPO@)
+pid = int(sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+os.environ["AT2_COORDINATOR"] = "127.0.0.1:@PORT@"
+os.environ["AT2_NUM_PROCESSES"] = "2"
+os.environ["AT2_PROCESS_ID"] = str(pid)
+from at2_node_tpu.parallel import multihost
+assert multihost.maybe_initialize() is True
+info = multihost.process_info()
+# the load-bearing topology claims: 2 real processes, global = 2x local
+assert info["process_count"] == 2, info
+assert info["local_devices"] == 4, info
+assert info["global_devices"] == 8, info
+
+# pool meshes stay HOST-LOCAL on a multi-process runtime (a per-node
+# verifier can never enter a cross-process collective in lockstep)
+from at2_node_tpu.parallel import pool
+local_mesh = pool.make_mesh()
+assert local_mesh.devices.size == 4, local_mesh
+assert all(
+    d.process_index == jax.process_index()
+    for d in local_mesh.devices.flat
+), "pool mesh leaked a remote device"
+"""
+
+
+def _run_two_procs(body: str, port: int, timeout: float):
+    """Spawn both SPMD processes, wait for both, return them."""
+    code = (_TWO_PROC_PREAMBLE + body).replace("@REPO@", repr(REPO)).replace(
+        "@PORT@", str(port)
+    )
+    env = {**os.environ, "JAX_PLATFORMS": ""}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+    return outs
+
+
+def test_two_process_distributed_runtime():
+    """A REAL 2-process distributed runtime (coordinator + worker over
+    loopback, 4 virtual CPU devices each): topology, pool-mesh locality,
+    and one single-controller SPMD program whose psum collective spans
+    both processes' devices."""
+    body = """
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+mesh = pool.make_mesh(jax.devices())  # explicit global mesh: all 8
+assert mesh.devices.size == 8
+shard = NamedSharding(mesh, PartitionSpec(pool.BATCH_AXIS))
+replicated = NamedSharding(mesh, PartitionSpec())
+
+full = np.arange(8, dtype=np.int32) + 1  # every process holds the input
+garr = jax.make_array_from_callback(full.shape, shard, lambda idx: full[idx])
+total = jax.jit(
+    lambda x: jnp.sum(x), in_shardings=(shard,), out_shardings=replicated
+)(garr)
+# the sharded->replicated transition is an AllReduce over both processes;
+# a wrong or hung collective cannot produce this in both of them
+assert int(total) == 36, int(total)
+print("MULTIHOST2_OK", info["process_count"], info["global_devices"])
+"""
+    outs = _run_two_procs(body, _free_port(), timeout=240)
+    for _, out, _ in outs:
+        assert "MULTIHOST2_OK 2 8" in out, out
+
+
+@pytest.mark.slow  # both processes pay a fresh XLA-CPU kernel compile
+def test_two_process_spmd_verify_spans_hosts():
+    """The BASELINE config-5 shape at process granularity: ONE sharded
+    ed25519 verify program spanning two processes' devices, with the
+    validity count psum-reduced across them."""
+    body = """
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.ops import ed25519 as kernel
+
+mesh = pool.make_mesh(jax.devices())
+assert mesh.devices.size == 8
+shard = NamedSharding(mesh, PartitionSpec(pool.BATCH_AXIS))
+
+kp = SignKeyPair.from_hex("52" * 32)
+msgs = [b"2proc%d" % i for i in range(8)]
+sigs = [kp.sign(m) for m in msgs]
+sigs[3] = b"\\x00" * 64  # one invalid lane
+prepared = kernel.prepare_batch([kp.public] * 8, msgs, sigs, 8)
+
+garrs = [
+    jax.make_array_from_callback(
+        np.asarray(x).shape, shard, lambda idx, x=np.asarray(x): x[idx]
+    )
+    for x in prepared
+]
+ok, count = pool._count_fn(mesh)(*garrs)
+# count is replicated: every process observes the global verdict of a
+# program whose lanes ran on BOTH processes' devices
+assert int(count) == 7, int(count)
+for s in ok.addressable_shards:
+    lane = int(np.asarray(s.index[0].start or 0))
+    want = [i != 3 for i in range(lane, lane + s.data.shape[0])]
+    assert list(np.asarray(s.data)) == want, (lane, s.data)
+print("MULTIHOST2_VERIFY_OK")
+"""
+    outs = _run_two_procs(body, _free_port(), timeout=420)
+    for _, out, _ in outs:
+        assert "MULTIHOST2_VERIFY_OK" in out, out
 
 
 def test_partial_configuration_raises_clearly(monkeypatch):

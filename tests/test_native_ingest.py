@@ -18,6 +18,10 @@ from at2_node_tpu.broadcast.messages import (
     READY,
     Attestation,
     ContentRequest,
+    HistoryBatch,
+    HistoryIndex,
+    HistoryIndexRequest,
+    HistoryRequest,
     Payload,
     parse_frame,
 )
@@ -55,13 +59,41 @@ def test_parse_differential_fuzz():
         msgs = []
         for _ in range(rng.randrange(1, 6)):
             roll = rng.random()
-            if roll < 0.4:
+            if roll < 0.35:
                 msgs.append(_rand_payload(rng))
-            elif roll < 0.8:
+            elif roll < 0.7:
                 msgs.append(_rand_attestation(rng))
-            else:
+            elif roll < 0.8:
                 msgs.append(
                     ContentRequest(rng.randbytes(32), rng.randrange(1 << 32), rng.randbytes(32))
+                )
+            elif roll < 0.85:
+                msgs.append(HistoryIndexRequest(rng.randrange(1 << 64)))
+            elif roll < 0.9:
+                msgs.append(
+                    HistoryRequest(
+                        rng.randrange(1 << 64),
+                        rng.randbytes(32),
+                        rng.randrange(1 << 32),
+                        rng.randrange(1 << 32),
+                    )
+                )
+            elif roll < 0.95:
+                msgs.append(
+                    HistoryIndex(
+                        rng.randrange(1 << 64),
+                        tuple(
+                            (rng.randbytes(32), rng.randrange(1 << 32))
+                            for _ in range(rng.randrange(0, 5))
+                        ),
+                    )
+                )
+            else:
+                msgs.append(
+                    HistoryBatch(
+                        rng.randrange(1 << 64),
+                        tuple(_rand_payload(rng) for _ in range(rng.randrange(0, 4))),
+                    )
                 )
         frames.append(b"".join(m.encode() for m in msgs))
     native, frame_ok = parse_frames_native(frames)
@@ -83,17 +115,29 @@ def test_parse_malformed_frames_drop_whole():
 
     rng = random.Random(9)
     good = _rand_payload(rng)
+    batch = HistoryBatch(7, (good, _rand_payload(rng)))
+    # one message past the coalescing cap: drops whole on BOTH paths
+    dense = HistoryIndexRequest(1).encode() * 4097
     cases = [
         good.encode(),
         b"\xff" + good.encode(),  # unknown kind
         good.encode()[:-1],  # truncated tail message
         good.encode() + b"\x02" + b"\x00" * 10,  # truncated attestation
         b"",  # empty frame parses to zero messages
+        batch.encode()[:-1],  # truncated history batch (count > entries)
+        b"\x06" + b"\x00" * 5,  # truncated history header
+        batch.encode(),
+        dense,  # exceeds MAX_MSGS_PER_FRAME
     ]
+    with pytest.raises(Exception):
+        parse_frame(dense)
     native, frame_ok = parse_frames_native(cases)
-    assert frame_ok.tolist() == [True, False, False, False, True]
-    assert [fi for fi, _ in native] == [0]
+    assert frame_ok.tolist() == [
+        True, False, False, False, True, False, False, True, False,
+    ]
+    assert [fi for fi, _ in native] == [0, 7]
     assert native[0][1] == good
+    assert native[1][1] == batch
 
 
 def test_verify_bulk_parity_and_threads():
@@ -175,7 +219,7 @@ def test_parse_chunk_native_vs_python(monkeypatch):
 
     import at2_node_tpu.native as native_pkg
 
-    monkeypatch.setattr(native_pkg, "ingest_available", lambda: False)
+    monkeypatch.setattr(native_pkg, "ingest_ready_or_kick", lambda: False)
     python_out = bc._parse_chunk(list(chunk))
 
     def key(pairs):
