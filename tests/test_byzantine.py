@@ -1,0 +1,212 @@
+"""Live byzantine NODE test (SURVEY.md §7 hard part 3, round-3 verdict
+item 7): a real hostile peer speaking the real encrypted transport
+against a net of real `Service` processes.
+
+The broadcast fuzz tier covers equivocation at the state-machine and
+schedule level (tests/test_broadcast_fuzz.py); here the adversary is a
+live wire-level participant: it is listed in every correct node's config
+(so its channels authenticate and its attestations verify — byzantine,
+not Sybil), dials them over the real X25519/ChaCha20 transport, and
+
+* gossips two CONFLICTING client-signed payloads for one slot to
+  different subsets of the net (a double-spend attempt by an
+  equivocating client, amplified by a colluding node);
+* double-echoes: votes content A to some peers and content B to others
+  for the same slot — the attack sieve's per-origin single-vote rule
+  exists for;
+* replays its own signed attestations verbatim (dedup must absorb);
+* sends Ready votes for a fabricated content hash on a fresh slot.
+
+With n=4 and f=1-tolerant thresholds (echo=ready=2 of 3 peers), two
+conflicting quorums would have to share a correct voter, so the correct
+trio must agree on at most ONE committed content for the equivocated
+slot — and must keep committing honest traffic throughout (the quorums
+are reachable from the 2 correct peers alone).
+
+The reference never exercises its stack against a byzantine peer (its
+full-quorum config sidesteps faults entirely — rpc.rs:112-120); this
+build's thresholds are configurable, so the tolerance is testable.
+"""
+
+import asyncio
+import itertools
+
+import pytest
+
+from at2_node_tpu.broadcast.messages import ECHO, READY, Attestation, Payload
+from at2_node_tpu.client import Client
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.net import transport
+from at2_node_tpu.net.peers import Peer
+from at2_node_tpu.node.config import Config
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.types import ThinTransaction
+
+TICK = 0.1
+TIMEOUT = 15.0
+
+_ports = itertools.count(22600)
+
+FAUCET = 100_000
+
+
+def make_configs(n, **kwargs):
+    cfgs = [
+        Config(
+            node_address=f"127.0.0.1:{next(_ports)}",
+            rpc_address=f"127.0.0.1:{next(_ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+            **kwargs,
+        )
+        for _ in range(n)
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.nodes = [
+            Peer(o.node_address, o.network_key.public, o.sign_key.public)
+            for j, o in enumerate(cfgs)
+            if j != i
+        ]
+    return cfgs
+
+
+async def wait_until(pred, timeout=TIMEOUT, what="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if await pred():
+            return
+        await asyncio.sleep(TICK)
+    raise TimeoutError(f"{what} not reached within {timeout}s")
+
+
+class _HostileNode:
+    """The byzantine participant: authenticated channels to every correct
+    node, crafted frames instead of a broadcast state machine."""
+
+    def __init__(self, config: Config):
+        self.sign = config.sign_key
+        self.network = config.network_key
+        self.channels = {}
+
+    async def dial(self, cfgs):
+        for i, cfg in enumerate(cfgs):
+            host, _, port = cfg.node_address.rpartition(":")
+            self.channels[i] = await transport.connect(
+                host, int(port), self.network
+            )
+
+    async def send(self, node: int, *msgs) -> None:
+        await self.channels[node].send(b"".join(m.encode() for m in msgs))
+
+    def attest(self, phase, sender, sequence, chash) -> Attestation:
+        sig = self.sign.sign(
+            Attestation.signing_bytes(phase, sender, sequence, chash)
+        )
+        return Attestation(phase, self.sign.public, sender, sequence, chash, sig)
+
+    def close(self):
+        for ch in self.channels.values():
+            ch.close()
+
+
+class TestByzantineNode:
+    @pytest.mark.asyncio
+    async def test_equivocation_double_echo_replay_fabricated_ready(self):
+        cfgs = make_configs(4, echo_threshold=2, ready_threshold=2)
+        services = [await Service.start(c) for c in cfgs[:3]]
+        hostile = _HostileNode(cfgs[3])
+        equivocator = SignKeyPair.random()
+        r1 = SignKeyPair.random().public
+        r2 = SignKeyPair.random().public
+        honest = SignKeyPair.random()
+        honest_rcpt = SignKeyPair.random().public
+        try:
+            await hostile.dial(cfgs[:3])
+
+            # -- attack 1: client equivocation amplified by the hostile
+            # node: conflicting payloads for slot (equivocator, 1)
+            tx_a = ThinTransaction(r1, 10)
+            tx_b = ThinTransaction(r2, 99)
+            pay_a = Payload(
+                equivocator.public, 1, tx_a,
+                equivocator.sign(tx_a.signing_bytes()),
+            )
+            pay_b = Payload(
+                equivocator.public, 1, tx_b,
+                equivocator.sign(tx_b.signing_bytes()),
+            )
+            await hostile.send(0, pay_a)
+            await hostile.send(1, pay_a)
+            await hostile.send(2, pay_b)
+
+            # -- attack 2: double-echo — A to nodes 0/1, B to node 2
+            echo_a = hostile.attest(
+                ECHO, equivocator.public, 1, pay_a.content_hash()
+            )
+            echo_b = hostile.attest(
+                ECHO, equivocator.public, 1, pay_b.content_hash()
+            )
+            await hostile.send(0, echo_a)
+            await hostile.send(1, echo_a)
+            await hostile.send(2, echo_b)
+
+            # -- attack 3: replay the same signed attestation verbatim
+            for _ in range(3):
+                await hostile.send(0, echo_a)
+
+            # -- attack 4: Ready votes for a fabricated content on a
+            # fresh slot (equivocator, 2) nobody gossiped
+            fake_ready = hostile.attest(READY, equivocator.public, 2, b"\x42" * 32)
+            for i in range(3):
+                await hostile.send(i, fake_ready)
+
+            # -- liveness: honest traffic keeps committing on the trio
+            # (quorums must be reachable without the byzantine node)
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset(honest, 1, honest_rcpt, 25)
+
+                async def honest_committed():
+                    for s in services:
+                        if await s.accounts.get_last_sequence(honest.public) < 1:
+                            return False
+                    return True
+
+                await wait_until(honest_committed, what="honest tx on trio")
+
+                # give the equivocated slot time to settle network-wide
+                async def slot_settled():
+                    for s in services:
+                        if await s.accounts.get_last_sequence(
+                            equivocator.public
+                        ) < 1:
+                            return False
+                    return True
+
+                await wait_until(slot_settled, what="equivocated slot settles")
+
+            # -- safety: the correct trio agrees on ONE committed content
+            seqs = {
+                await s.accounts.get_last_sequence(equivocator.public)
+                for s in services
+            }
+            assert seqs == {1}, seqs
+            bal_r1 = {await s.accounts.get_balance(r1) for s in services}
+            bal_r2 = {await s.accounts.get_balance(r2) for s in services}
+            assert len(bal_r1) == 1 and len(bal_r2) == 1, (bal_r1, bal_r2)
+            # exactly one of the conflicting transfers committed — and
+            # with these thresholds content A deterministically wins (B
+            # can collect at most 1 echo vote at any correct node)
+            assert bal_r1 == {FAUCET + 10}, bal_r1
+            assert bal_r2 == {FAUCET}, bal_r2
+            # the fabricated-content slot never commits anywhere
+            for s in services:
+                assert (
+                    await s.accounts.get_last_sequence(equivocator.public) == 1
+                )
+            # honest transfer landed everywhere
+            for s in services:
+                assert await s.accounts.get_balance(honest_rcpt) == FAUCET + 25
+        finally:
+            hostile.close()
+            for s in services:
+                await s.close()
