@@ -64,3 +64,27 @@ def pytest_pyfunc_call(pyfuncitem):
         asyncio.run(_run_with_watchdog(fn(**kwargs)))
         return True
     return None
+
+
+# ---- shared multi-node test helpers (one copy; each test module passes
+# its own port counter so ranges stay disjoint across files) ----
+
+NET_TICK = 0.1
+NET_TIMEOUT = 15.0
+
+
+def make_net_configs(n, ports, **config_overrides):
+    """N full-mesh node Configs with fresh keys — delegates to the tools'
+    canonical builder so tests and benches construct nets one way."""
+    from at2_node_tpu.tools._common import make_net_configs as _make
+
+    return _make(n, ports, **config_overrides)
+
+
+async def wait_until(pred, timeout=NET_TIMEOUT, what="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if await pred():
+            return
+        await asyncio.sleep(NET_TICK)
+    raise TimeoutError(f"{what} not reached within {timeout}s")

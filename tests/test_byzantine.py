@@ -17,66 +17,42 @@ not Sybil), dials them over the real X25519/ChaCha20 transport, and
 * replays its own signed attestations verbatim (dedup must absorb);
 * sends Ready votes for a fabricated content hash on a fresh slot.
 
-With n=4 and f=1-tolerant thresholds (echo=ready=2 of 3 peers), two
-conflicting quorums would have to share a correct voter, so the correct
-trio must agree on at most ONE committed content for the equivocated
-slot — and must keep committing honest traffic throughout (the quorums
-are reachable from the 2 correct peers alone).
+Threshold math (this build counts votes over PEERS, self excluded —
+broadcast/stack.py module docstring): for two correct nodes to deliver
+CONFLICTING contents, each needs an echo quorum of t among its
+n_peers = n-1 peers, and the two vote sets intersect in at least
+2t - (n-1) peers; every correct peer echoes ONE content to everyone, so
+each shared voter backing both quorums must be byzantine. Safety against
+f byzantine therefore needs 2t - (n-1) > f. With n=5, t=3, f=1:
+intersection >= 2 > 1 — equivocation cannot double-commit. Liveness
+needs t reachable from correct peers alone: each correct node has
+(n-1) - f = 3 = t correct peers. (A 4-node/t=2 config would NOT be
+f=1-safe here: 2t - 3 = 1 quorum overlap can be exactly the byzantine
+double-voter — one node more than classic BFT is the price of
+self-excluded counting.)
 
 The reference never exercises its stack against a byzantine peer (its
 full-quorum config sidesteps faults entirely — rpc.rs:112-120); this
 build's thresholds are configurable, so the tolerance is testable.
 """
 
-import asyncio
 import itertools
 
 import pytest
 
 from at2_node_tpu.broadcast.messages import ECHO, READY, Attestation, Payload
 from at2_node_tpu.client import Client
-from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.crypto.keys import SignKeyPair
 from at2_node_tpu.net import transport
-from at2_node_tpu.net.peers import Peer
 from at2_node_tpu.node.config import Config
 from at2_node_tpu.node.service import Service
 from at2_node_tpu.types import ThinTransaction
 
-TICK = 0.1
-TIMEOUT = 15.0
+from conftest import make_net_configs, wait_until
 
 _ports = itertools.count(22600)
 
 FAUCET = 100_000
-
-
-def make_configs(n, **kwargs):
-    cfgs = [
-        Config(
-            node_address=f"127.0.0.1:{next(_ports)}",
-            rpc_address=f"127.0.0.1:{next(_ports)}",
-            sign_key=SignKeyPair.random(),
-            network_key=ExchangeKeyPair.random(),
-            **kwargs,
-        )
-        for _ in range(n)
-    ]
-    for i, cfg in enumerate(cfgs):
-        cfg.nodes = [
-            Peer(o.node_address, o.network_key.public, o.sign_key.public)
-            for j, o in enumerate(cfgs)
-            if j != i
-        ]
-    return cfgs
-
-
-async def wait_until(pred, timeout=TIMEOUT, what="condition"):
-    deadline = asyncio.get_event_loop().time() + timeout
-    while asyncio.get_event_loop().time() < deadline:
-        if await pred():
-            return
-        await asyncio.sleep(TICK)
-    raise TimeoutError(f"{what} not reached within {timeout}s")
 
 
 class _HostileNode:
@@ -112,19 +88,23 @@ class _HostileNode:
 class TestByzantineNode:
     @pytest.mark.asyncio
     async def test_equivocation_double_echo_replay_fabricated_ready(self):
-        cfgs = make_configs(4, echo_threshold=2, ready_threshold=2)
-        services = [await Service.start(c) for c in cfgs[:3]]
-        hostile = _HostileNode(cfgs[3])
+        # n=5, f=1: 4 correct Services + the hostile node, thresholds 3
+        # (see module docstring for why 3-of-4-peers is the f=1-safe
+        # configuration under self-excluded vote counting)
+        cfgs = make_net_configs(5, _ports, echo_threshold=3, ready_threshold=3)
+        services = [await Service.start(c) for c in cfgs[:4]]
+        hostile = _HostileNode(cfgs[4])
         equivocator = SignKeyPair.random()
         r1 = SignKeyPair.random().public
         r2 = SignKeyPair.random().public
         honest = SignKeyPair.random()
         honest_rcpt = SignKeyPair.random().public
         try:
-            await hostile.dial(cfgs[:3])
+            await hostile.dial(cfgs[:4])
 
             # -- attack 1: client equivocation amplified by the hostile
-            # node: conflicting payloads for slot (equivocator, 1)
+            # node: conflicting payloads for slot (equivocator, 1) —
+            # A to nodes 0-2, B to node 3
             tx_a = ThinTransaction(r1, 10)
             tx_b = ThinTransaction(r2, 99)
             pay_a = Payload(
@@ -135,11 +115,11 @@ class TestByzantineNode:
                 equivocator.public, 1, tx_b,
                 equivocator.sign(tx_b.signing_bytes()),
             )
-            await hostile.send(0, pay_a)
-            await hostile.send(1, pay_a)
-            await hostile.send(2, pay_b)
+            for i in range(3):
+                await hostile.send(i, pay_a)
+            await hostile.send(3, pay_b)
 
-            # -- attack 2: double-echo — A to nodes 0/1, B to node 2
+            # -- attack 2: double-echo — A to nodes 0/1, B to nodes 2/3
             echo_a = hostile.attest(
                 ECHO, equivocator.public, 1, pay_a.content_hash()
             )
@@ -149,19 +129,22 @@ class TestByzantineNode:
             await hostile.send(0, echo_a)
             await hostile.send(1, echo_a)
             await hostile.send(2, echo_b)
+            await hostile.send(3, echo_b)
 
             # -- attack 3: replay the same signed attestation verbatim
             for _ in range(3):
                 await hostile.send(0, echo_a)
 
             # -- attack 4: Ready votes for a fabricated content on a
-            # fresh slot (equivocator, 2) nobody gossiped
+            # fresh slot (equivocator, 2) nobody gossiped — one origin's
+            # vote stays far below the ready threshold
             fake_ready = hostile.attest(READY, equivocator.public, 2, b"\x42" * 32)
-            for i in range(3):
+            for i in range(4):
                 await hostile.send(i, fake_ready)
 
-            # -- liveness: honest traffic keeps committing on the trio
-            # (quorums must be reachable without the byzantine node)
+            # -- liveness: honest traffic keeps committing on the
+            # correct nodes (echo quorum 3 = the 3 correct peers each
+            # node has; the byzantine node contributes nothing)
             async with Client(f"http://{cfgs[0].rpc_address}") as client:
                 await client.send_asset(honest, 1, honest_rcpt, 25)
 
@@ -171,9 +154,14 @@ class TestByzantineNode:
                             return False
                     return True
 
-                await wait_until(honest_committed, what="honest tx on trio")
+                await wait_until(honest_committed, what="honest tx on correct nodes")
 
-                # give the equivocated slot time to settle network-wide
+                # the equivocated slot settles: content A deterministically
+                # wins (B's echo votes at any correct node top out at
+                # {node3, hostile} = 2 < 3, while A gathers the other
+                # three correct echoes everywhere; node3 itself
+                # sieve-delivers A from {node0,node1,node2} and the Ready
+                # quorum {node0,node1,node2,node3} amplifies the rest)
                 async def slot_settled():
                     for s in services:
                         if await s.accounts.get_last_sequence(
@@ -184,28 +172,23 @@ class TestByzantineNode:
 
                 await wait_until(slot_settled, what="equivocated slot settles")
 
-            # -- safety: the correct trio agrees on ONE committed content
+            # -- safety: every correct node committed the SAME content
             seqs = {
                 await s.accounts.get_last_sequence(equivocator.public)
                 for s in services
             }
             assert seqs == {1}, seqs
-            bal_r1 = {await s.accounts.get_balance(r1) for s in services}
-            bal_r2 = {await s.accounts.get_balance(r2) for s in services}
-            assert len(bal_r1) == 1 and len(bal_r2) == 1, (bal_r1, bal_r2)
-            # exactly one of the conflicting transfers committed — and
-            # with these thresholds content A deterministically wins (B
-            # can collect at most 1 echo vote at any correct node)
-            assert bal_r1 == {FAUCET + 10}, bal_r1
-            assert bal_r2 == {FAUCET}, bal_r2
-            # the fabricated-content slot never commits anywhere
             for s in services:
-                assert (
-                    await s.accounts.get_last_sequence(equivocator.public) == 1
-                )
-            # honest transfer landed everywhere
-            for s in services:
+                assert await s.accounts.get_balance(r1) == FAUCET + 10
+                assert await s.accounts.get_balance(r2) == FAUCET
                 assert await s.accounts.get_balance(honest_rcpt) == FAUCET + 25
+            # the fabricated-content slot (equivocator, 2) was never
+            # DELIVERED anywhere: each node delivered exactly the honest
+            # slot and the equivocated slot
+            for s in services:
+                assert s.broadcast.stats["delivered"] == 2, (
+                    s.broadcast.stats
+                )
         finally:
             hostile.close()
             for s in services:

@@ -34,13 +34,11 @@ import pytest
 
 from at2_node_tpu.broadcast.messages import Payload, parse_frame
 from at2_node_tpu.client import Client
-from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
-from at2_node_tpu.net.peers import Peer
-from at2_node_tpu.node.config import CatchupConfig, CheckpointConfig, Config
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.node.config import CatchupConfig, CheckpointConfig
 from at2_node_tpu.node.service import Service
 
-TICK = 0.1
-TIMEOUT = 15.0
+from conftest import make_net_configs, wait_until
 
 _ports = itertools.count(21600)
 
@@ -48,32 +46,7 @@ FAUCET = 100_000
 
 
 def make_configs(n, **kwargs):
-    cfgs = [
-        Config(
-            node_address=f"127.0.0.1:{next(_ports)}",
-            rpc_address=f"127.0.0.1:{next(_ports)}",
-            sign_key=SignKeyPair.random(),
-            network_key=ExchangeKeyPair.random(),
-            **kwargs,
-        )
-        for _ in range(n)
-    ]
-    for i, cfg in enumerate(cfgs):
-        cfg.nodes = [
-            Peer(o.node_address, o.network_key.public, o.sign_key.public)
-            for j, o in enumerate(cfgs)
-            if j != i
-        ]
-    return cfgs
-
-
-async def wait_until(pred, timeout=TIMEOUT, what="condition"):
-    deadline = asyncio.get_event_loop().time() + timeout
-    while asyncio.get_event_loop().time() < deadline:
-        if await pred():
-            return
-        await asyncio.sleep(TICK)
-    raise TimeoutError(f"{what} not reached within {timeout}s")
+    return make_net_configs(n, _ports, **kwargs)
 
 
 class TestKillRestartRedial:

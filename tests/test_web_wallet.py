@@ -1,9 +1,20 @@
 """Pins for the browser wallet page (web/wallet.html).
 
 No JS runtime exists in this image, so the page cannot be executed in
-CI; its wire behavior (grpc-web-text framing, protobuf shapes, CORS) is
-what the interop tier pins with stock HTTP clients. What CAN be checked
-offline, is checked here:
+CI. The byte-level codec check therefore runs in two halves that meet at
+a golden-vector block embedded in the page:
+
+* this test REGENERATES every vector from the server's own protobuf
+  bindings (at2_pb2) plus the canonical grpc-web-text framing, and
+  byte-compares against the block between the page's GOLDEN-BEGIN/END
+  markers — any drift between page, proto, or framing fails CI;
+* the page runs `selfTest()` at load, driving its real encoder/decoder
+  functions (varint, pbBytes/pbUint, frameB64, pbDecode,
+  parseGrpcWebBody) against the same vectors, and DISABLES the wallet
+  on mismatch — so the JS half of the contract is enforced by the only
+  JS executor in the loop, the user's browser, before any signing.
+
+Also pinned here (pre-existing):
 
 * the PKCS8 prefix the page uses to import raw Ed25519 seeds into
   WebCrypto is byte-identical to the real PKCS8 encoding `cryptography`
@@ -15,6 +26,8 @@ offline, is checked here:
 * the page references the correct service path and content type.
 """
 
+import base64
+import json
 import os
 import re
 
@@ -22,6 +35,7 @@ from cryptography.hazmat.primitives import serialization
 from cryptography.hazmat.primitives.asymmetric import ed25519
 
 from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.proto import at2_pb2 as pb
 from at2_node_tpu.types import ThinTransaction
 
 PAGE = os.path.join(
@@ -68,6 +82,108 @@ def test_signing_layout_matches_canonical():
     from at2_node_tpu.crypto.keys import verify_one
 
     assert verify_one(kp.public, thin.signing_bytes(), sig)
+
+
+def _expected_golden() -> dict:
+    """The vectors as the SERVER's own bindings produce them — the
+    oracle the page's embedded block must match byte-for-byte."""
+    sender = bytes(range(32))
+    recipient = bytes(range(32, 64))
+    signature = bytes(range(64, 128))
+    sequence = 300
+    amount = (1 << 32) + 5
+
+    sa = pb.SendAssetRequest(
+        sender=sender, sequence=sequence, recipient=recipient,
+        amount=amount, signature=signature,
+    ).SerializeToString()
+    frame = b"\x00" + len(sa).to_bytes(4, "big") + sa
+    reply = pb.GetBalanceReply(amount=100_000).SerializeToString()
+    tx = pb.FullTransaction(
+        timestamp="2026-07-31T00:00:00Z", sender=sender, recipient=recipient,
+        amount=7, state=1, sender_sequence=9,
+    ).SerializeToString()
+    trailer = b"grpc-status:0\r\n"
+    resp_body = (
+        b"\x00" + len(reply).to_bytes(4, "big") + reply
+        + b"\x80" + len(trailer).to_bytes(4, "big") + trailer
+    )
+
+    def var(n: int) -> bytes:
+        out = bytearray()
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out.append(b | (0x80 if n else 0))
+            if not n:
+                return bytes(out)
+
+    return {
+        "send_asset": {
+            "sender": sender.hex(),
+            "sequence": sequence,
+            "recipient": recipient.hex(),
+            "amount": str(amount),
+            "signature": signature.hex(),
+            "expect": sa.hex(),
+            "expect_frame_b64": base64.b64encode(frame).decode(),
+        },
+        "get_balance_request": {
+            "expect": pb.GetBalanceRequest(sender=sender)
+            .SerializeToString()
+            .hex()
+        },
+        "balance_reply": {"bytes": reply.hex(), "amount": "100000"},
+        "full_transaction": {
+            "bytes": tx.hex(),
+            "timestamp": "2026-07-31T00:00:00Z",
+            "sender": sender.hex(),
+            "recipient": recipient.hex(),
+            "amount": "7",
+            "state": 1,
+            "sender_sequence": "9",
+        },
+        "response_body_b64": {
+            "b64": base64.b64encode(resp_body).decode(),
+            "data": reply.hex(),
+            "status": 0,
+        },
+        "varints": [
+            [str(n), var(n).hex()]
+            for n in [0, 1, 127, 128, 300, (1 << 32) + 5, (1 << 64) - 1]
+        ],
+    }
+
+
+def test_golden_vectors_match_at2_pb2_byte_for_byte():
+    match = re.search(
+        r"/\* GOLDEN-BEGIN \*/\s*(\{.*?\})\s*/\* GOLDEN-END \*/",
+        _page(),
+        re.DOTALL,
+    )
+    assert match, "GOLDEN vector block missing from the page"
+    embedded = json.loads(match.group(1))
+    assert embedded == _expected_golden(), (
+        "the page's golden vectors diverge from at2_pb2's byte output — "
+        "regenerate the block (tests/test_web_wallet.py _expected_golden)"
+    )
+
+
+def test_self_test_gates_the_wallet():
+    """The page must run selfTest() BEFORE wiring any button, and a
+    failure must disable the UI — the vectors are only load-bearing if
+    their check actually gates operation."""
+    page = _page()
+    assert "selfTest();" in page
+    gate = page.index("selfTest();")
+    wiring = page.index('["load", loadKey]')
+    assert gate < wiring, "self-test must run before the UI is wired"
+    assert '$(id).disabled = true' in page
+    # every codec function the wallet uses at runtime appears in the test
+    for fn in ("varint(", "pbBytes(", "pbUint(", "pbDecode(",
+               "frameB64(", "parseGrpcWebBody("):
+        body = page[page.index("function selfTest()"):page.index("try {")]
+        assert fn in body, f"selfTest does not exercise {fn}"
 
 
 def test_page_targets_the_served_surface():
