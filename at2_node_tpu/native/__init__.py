@@ -17,8 +17,11 @@ from .ingest import (
     verify_bulk_native,
 )
 from .prep import native_available, prep_batch_native
+from .reader import NativeChannelReader, reader_available
 
 __all__ = [
+    "NativeChannelReader",
+    "reader_available",
     "ingest_available",
     "ingest_ready",
     "ingest_ready_or_kick",

@@ -32,12 +32,19 @@
 // body's (offset, length) into the caller's flat buffer and Python decodes
 // the slice — they are rare control traffic, not the hot path.
 
+#include <atomic>
+#include <cerrno>
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include <sys/socket.h>
+#include <unistd.h>
 
 // ---------------- OpenSSL 3 EVP surface (no headers in the image; the
 // declarations below are the stable libcrypto ABI) ----------------
@@ -47,6 +54,8 @@ typedef struct evp_pkey_st EVP_PKEY;
 typedef struct evp_md_ctx_st EVP_MD_CTX;
 typedef struct engine_st ENGINE;
 typedef struct evp_md_st EVP_MD;
+typedef struct evp_cipher_st EVP_CIPHER;
+typedef struct evp_cipher_ctx_st EVP_CIPHER_CTX;
 EVP_PKEY* EVP_PKEY_new_raw_public_key(int type, ENGINE* e,
                                       const unsigned char* pub, size_t len);
 void EVP_PKEY_free(EVP_PKEY* k);
@@ -57,9 +66,21 @@ int EVP_DigestVerifyInit(EVP_MD_CTX* ctx, void** pctx, const EVP_MD* type,
                          ENGINE* e, EVP_PKEY* pkey);
 int EVP_DigestVerify(EVP_MD_CTX* ctx, const unsigned char* sig, size_t siglen,
                      const unsigned char* data, size_t datalen);
+const EVP_CIPHER* EVP_chacha20_poly1305(void);
+EVP_CIPHER_CTX* EVP_CIPHER_CTX_new(void);
+void EVP_CIPHER_CTX_free(EVP_CIPHER_CTX* ctx);
+int EVP_DecryptInit_ex(EVP_CIPHER_CTX* ctx, const EVP_CIPHER* cipher,
+                       ENGINE* impl, const unsigned char* key,
+                       const unsigned char* iv);
+int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX* ctx, int type, int arg, void* ptr);
+int EVP_DecryptUpdate(EVP_CIPHER_CTX* ctx, unsigned char* out, int* outl,
+                      const unsigned char* in, int inl);
+int EVP_DecryptFinal_ex(EVP_CIPHER_CTX* ctx, unsigned char* outm, int* outl);
 }
 
 static constexpr int kEvpPkeyEd25519 = 1087;  // NID_ED25519
+static constexpr int kEvpCtrlAeadSetIvlen = 0x9;
+static constexpr int kEvpCtrlAeadSetTag = 0x11;
 
 namespace {
 
@@ -293,6 +314,178 @@ void at2_verify_bulk(const uint8_t* pk_flat, const uint64_t* pk_off,
     threads.emplace_back(worker, lo, hi);
   }
   for (auto& th : threads) th.join();
+}
+
+}  // extern "C"
+
+// ---------------- native channel reader ----------------
+//
+// One thread per INBOUND mesh connection (the responder side only ever
+// reads — net/peers.py's one-connection-per-ordered-pair design). The
+// thread owns the socket reads, the per-frame ChaCha20-Poly1305
+// decryption (transport.py wire format: u32-LE ciphertext length ||
+// ciphertext, nonce = LE frame counter || 4 zero bytes, 16-byte tag
+// appended), and frame assembly; decrypted frames accumulate in a
+// byte-bounded queue and Python is woken via ONE pipe byte per
+// empty->nonempty transition — collapsing the per-frame event-loop
+// wakeups that profiling showed were the plane's asyncio floor
+// (BENCH_E2E.json analysis). Parsing stays in the existing per-chunk
+// native call, so the inbox byte budget and catchup plane are
+// untouched.
+
+namespace {
+
+constexpr size_t kReaderMaxFrame = 16 * 1024 * 1024;  // transport.MAX_FRAME
+constexpr size_t kReaderQueueBytes = 32 * 1024 * 1024;
+
+struct At2Reader {
+  int fd = -1;
+  int wake_fd = -1;
+  uint8_t key[32];
+  uint64_t ctr = 0;
+  std::thread thread;
+  std::mutex mu;
+  std::deque<std::vector<uint8_t>> pending;
+  size_t pending_bytes = 0;
+  int32_t status = 0;  // 0 open, 1 clean eof, 2 protocol/decrypt error
+  uint64_t drops = 0;
+  std::atomic<bool> stopping{false};
+
+  bool read_exact(uint8_t* buf, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = ::read(fd, buf + off, n - off);
+      if (r > 0) {
+        off += size_t(r);
+      } else if (r == 0) {
+        return false;  // eof
+      } else if (errno == EINTR) {
+        continue;
+      } else {
+        return false;
+      }
+    }
+    return true;
+  }
+
+  void wake() {
+    uint8_t b = 1;
+    // best-effort: a full pipe already guarantees a pending wakeup
+    (void)!::write(wake_fd, &b, 1);
+  }
+
+  void finish(int32_t st) {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      status = st;
+    }
+    wake();
+  }
+
+  void run() {
+    EVP_CIPHER_CTX* ctx = EVP_CIPHER_CTX_new();
+    if (ctx == nullptr) { finish(2); return; }
+    std::vector<uint8_t> ct, pt;
+    while (!stopping.load(std::memory_order_relaxed)) {
+      uint8_t hdr[4];
+      if (!read_exact(hdr, 4)) { finish(1); break; }
+      uint32_t len = le32(hdr);
+      if (len < 16 || len > kReaderMaxFrame) { finish(2); break; }
+      ct.resize(len);
+      if (!read_exact(ct.data(), len)) { finish(1); break; }
+      uint8_t iv[12] = {0};
+      uint64_t c = ctr++;
+      for (int i = 0; i < 8; i++) iv[i] = uint8_t(c >> (8 * i));
+      pt.resize(len - 16);
+      int outl = 0, finl = 0;
+      bool ok = EVP_DecryptInit_ex(ctx, EVP_chacha20_poly1305(), nullptr,
+                                   nullptr, nullptr) == 1 &&
+                EVP_CIPHER_CTX_ctrl(ctx, kEvpCtrlAeadSetIvlen, 12,
+                                    nullptr) == 1 &&
+                EVP_DecryptInit_ex(ctx, nullptr, nullptr, key, iv) == 1 &&
+                EVP_DecryptUpdate(ctx, pt.data(), &outl, ct.data(),
+                                  int(len - 16)) == 1 &&
+                EVP_CIPHER_CTX_ctrl(ctx, kEvpCtrlAeadSetTag, 16,
+                                    ct.data() + (len - 16)) == 1 &&
+                EVP_DecryptFinal_ex(ctx, pt.data() + outl, &finl) == 1;
+      if (!ok || size_t(outl + finl) != pt.size()) {
+        finish(2);  // bad tag == wire corruption/attacker: channel-fatal
+        break;
+      }
+      bool was_empty;
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        if (pending_bytes + pt.size() > kReaderQueueBytes) {
+          drops++;  // best-effort plane: saturated queue drops new frames
+          continue;
+        }
+        was_empty = pending.empty();
+        pending_bytes += pt.size();
+        pending.emplace_back(std::move(pt));
+        pt = std::vector<uint8_t>();
+      }
+      if (was_empty) wake();
+    }
+    EVP_CIPHER_CTX_free(ctx);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* at2_reader_start(int fd, const uint8_t* key, int wake_fd) {
+  auto* r = new At2Reader();
+  r->fd = fd;
+  r->wake_fd = wake_fd;
+  std::memcpy(r->key, key, 32);
+  r->thread = std::thread([r] { r->run(); });
+  return r;
+}
+
+// Copy out queued frames: up to max_frames frames whose total size fits
+// buf_cap. offsets[0..n] are frame boundaries in buf. Returns the frame
+// count (0 = nothing pending), or -(size) when the next frame alone
+// exceeds buf_cap (the caller grows its buffer and retries — a frame can
+// legitimately be up to transport.MAX_FRAME). *status_out reports the
+// channel state and *drops_out the saturated-queue drop counter.
+int64_t at2_reader_take(void* handle, uint8_t* buf, int64_t buf_cap,
+                        uint64_t* offsets, int64_t max_frames,
+                        int32_t* status_out, uint64_t* drops_out) {
+  auto* r = static_cast<At2Reader*>(handle);
+  std::lock_guard<std::mutex> lock(r->mu);
+  int64_t n = 0;
+  uint64_t off = 0;
+  offsets[0] = 0;
+  while (n < max_frames && !r->pending.empty()) {
+    auto& f = r->pending.front();
+    if (off + f.size() > uint64_t(buf_cap)) {
+      if (n == 0) {
+        *status_out = r->status;
+        *drops_out = r->drops;
+        return -int64_t(f.size());
+      }
+      break;
+    }
+    std::memcpy(buf + off, f.data(), f.size());
+    off += f.size();
+    offsets[++n] = off;
+    r->pending_bytes -= f.size();
+    r->pending.pop_front();
+  }
+  *status_out = r->status;
+  *drops_out = r->drops;
+  return n;
+}
+
+// Stop the thread (shutdown unblocks the read), join, free. The caller
+// still owns fd and wake_fd and closes them afterwards.
+void at2_reader_stop(void* handle) {
+  auto* r = static_cast<At2Reader*>(handle);
+  r->stopping.store(true, std::memory_order_relaxed);
+  ::shutdown(r->fd, SHUT_RD);
+  if (r->thread.joinable()) r->thread.join();
+  delete r;
 }
 
 // Layout exports so the Python binding never hardcodes them.

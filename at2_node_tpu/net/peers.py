@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import socket as socket_mod
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Dict, Iterable, List, Optional
 
@@ -91,29 +93,58 @@ class Mesh:
         self._tasks: list = []
         self._channels: set = set()  # live channels, closed on shutdown
         self._closed = False
+        # native-reader inbound plane (net docstring in native/reader.py):
+        # wake-pipe read fd -> [peer, reader, sock, wake_write_fd, drops]
+        self._native_by_fd: Dict[int, list] = {}
+        self._listen_sock: Optional[socket_mod.socket] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         # observability counters (SURVEY.md §5): connection churn and
         # best-effort-plane drops are the operator's failure-detection
         # signals
         self.redials = 0  # established connections dropped + re-dialed
         self.dial_failures = 0  # connect/handshake attempts that failed
         self.send_overflows = 0
+        self._reader_drops_closed = 0  # drops of already-closed readers
 
     def stats(self) -> dict:
         return {
-            "channels": len(self._channels),
+            "channels": len(self._channels) + len(self._native_by_fd),
             "send_queue_depth": sum(
                 q.qsize() for q in self._send_queues.values()
             ),
             "redials": self.redials,
             "dial_failures": self.dial_failures,
             "send_overflows": self.send_overflows,
+            "native_readers": len(self._native_by_fd),
+            # cumulative like send_overflows: closed channels' drops must
+            # not vanish from the operator's failure-detection signal
+            "reader_drops": self._reader_drops_closed
+            + sum(e[4] for e in self._native_by_fd.values()),
         }
 
     async def start(self) -> None:
+        from ..native.reader import reader_available
+
+        self._loop = asyncio.get_running_loop()
         host, _, port = self.listen_addr.rpartition(":")
-        self._server = await asyncio.start_server(
-            self._handle_inbound, host or "0.0.0.0", int(port)
-        )
+        if reader_available():
+            # native inbound plane: the listen socket is accepted manually
+            # so the connection's fd can be handed to a C++ reader thread
+            # wholesale after the handshake (asyncio never owns its
+            # stream buffers)
+            s = socket_mod.socket()
+            s.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+            s.bind((host or "0.0.0.0", int(port)))
+            s.listen(128)
+            s.setblocking(False)
+            self._listen_sock = s
+            self._tasks.append(
+                asyncio.create_task(self._native_accept_loop())
+            )
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_inbound, host or "0.0.0.0", int(port)
+            )
         for peer in self.peers:
             q: asyncio.Queue = asyncio.Queue(maxsize=SEND_QUEUE_CAP)
             self._send_queues[peer.exchange_public] = q
@@ -128,6 +159,11 @@ class Mesh:
         for channel in list(self._channels):
             channel.close()
         self._channels.clear()
+        for rfd in list(self._native_by_fd):
+            self._native_close(rfd)
+        if self._listen_sock is not None:
+            self._listen_sock.close()
+            self._listen_sock = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -216,6 +252,132 @@ class Mesh:
             finally:
                 channel.close()
                 self._channels.discard(channel)
+
+    # -- native inbound plane (C++ reader threads) ------------------------
+
+    async def _native_accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _addr = await self._loop.sock_accept(self._listen_sock)
+            except (OSError, asyncio.CancelledError):
+                return
+            task = asyncio.create_task(self._native_inbound(sock))
+            self._tasks.append(task)
+            # prune on completion: inbound churn (a flapping peer
+            # redialing for days) must not grow _tasks without bound
+            task.add_done_callback(
+                lambda t: self._tasks.remove(t) if t in self._tasks else None
+            )
+
+    async def _native_handshake(self, sock) -> tuple:
+        """Responder handshake over the raw socket — same hello exchange
+        as transport.accept (key derivation shared via
+        transport.responder_session_keys), but leaving the socket's
+        kernel buffer untouched past the 64 hello bytes so the C++
+        reader starts from frame 0."""
+        own_nonce = os.urandom(32)
+        await self._loop.sock_sendall(sock, self.keypair.public + own_nonce)
+        hello = b""
+        while len(hello) < 64:
+            chunk = await self._loop.sock_recv(sock, 64 - len(hello))
+            if not chunk:
+                raise transport.HandshakeError("peer closed during handshake")
+            hello += chunk
+        peer_public, k_i2r, _ = transport.responder_session_keys(
+            self.keypair, own_nonce, hello
+        )
+        return peer_public, k_i2r
+
+    async def _native_inbound(self, sock) -> None:
+        from ..native.reader import NativeChannelReader
+
+        sock.setblocking(False)
+        try:
+            peer_public, recv_key = await asyncio.wait_for(
+                self._native_handshake(sock), 5.0
+            )
+        except (
+            transport.HandshakeError,
+            asyncio.TimeoutError,
+            OSError,
+            ConnectionError,
+        ):
+            sock.close()
+            return
+        except BaseException:
+            # cancellation from Mesh.close() mid-handshake: the accepted
+            # socket must not leak to GC finalization
+            sock.close()
+            raise
+        peer = self.by_exchange.get(peer_public)
+        if peer is None:
+            logger.warning(
+                "rejecting connection from unknown key %s", peer_public.hex()
+            )
+            sock.close()
+            return
+        # the C++ thread does blocking reads; the handshake needed the
+        # socket non-blocking for the asyncio sock_* calls
+        sock.setblocking(True)
+        rfd, wfd = os.pipe()
+        os.set_blocking(rfd, False)
+        os.set_blocking(wfd, False)
+        rdr = NativeChannelReader(sock.fileno(), recv_key, wfd)
+        self._native_by_fd[rfd] = [peer, rdr, sock, wfd, 0]
+        self._loop.add_reader(rfd, self._native_wake, rfd)
+
+    def _native_wake(self, rfd: int) -> None:
+        """One wakeup per frame BATCH: drain the pipe, take every queued
+        frame, deliver them through the normal on_frame path in a single
+        task (on_frame never awaits internally, so ordering holds)."""
+        from ..native.reader import STATUS_OPEN
+
+        entry = self._native_by_fd.get(rfd)
+        if entry is None:
+            return
+        peer, rdr, _sock, _wfd, _ = entry
+        try:
+            os.read(rfd, 65536)
+        except (BlockingIOError, OSError):
+            pass
+        frames: list = []
+        while True:
+            batch, status, drops = rdr.take()
+            frames.extend(batch)
+            if not batch:
+                break
+        entry[4] = drops
+        if frames:
+            task = asyncio.ensure_future(self._deliver_frames(peer, frames))
+            task.add_done_callback(self._log_deliver_error)
+        if status != STATUS_OPEN:
+            # eof or protocol/decrypt failure: channel-fatal, normal drop
+            # (the initiating side redials; same semantics as
+            # transport.ChannelClosed on the asyncio path)
+            self._native_close(rfd)
+
+    async def _deliver_frames(self, peer: Peer, frames: list) -> None:
+        for frame in frames:
+            await self.on_frame(peer, frame)
+
+    @staticmethod
+    def _log_deliver_error(task) -> None:
+        if not task.cancelled() and task.exception() is not None:
+            logger.exception(
+                "inbound frame delivery failed", exc_info=task.exception()
+            )
+
+    def _native_close(self, rfd: int) -> None:
+        entry = self._native_by_fd.pop(rfd, None)
+        if entry is None:
+            return
+        _peer, rdr, sock, wfd, drops = entry
+        self._reader_drops_closed += drops
+        self._loop.remove_reader(rfd)
+        rdr.stop()
+        os.close(rfd)
+        os.close(wfd)
+        sock.close()
 
     async def _handle_inbound(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

@@ -154,6 +154,21 @@ def _shared_or_raise(keypair: ExchangeKeyPair, peer_public: bytes) -> bytes:
         raise HandshakeError(f"bad peer key: {exc}") from exc
 
 
+def responder_session_keys(
+    keypair: ExchangeKeyPair, own_nonce: bytes, hello: bytes
+) -> tuple:
+    """Responder-side key material from the peer's 64-byte hello: returns
+    (peer_public, k_i2r, k_r2i). THE one implementation — used by both
+    the asyncio accept path below and the native-reader accept path
+    (net/peers.py), so the two inbound planes can never drift."""
+    peer_public, peer_nonce = hello[:32], hello[32:64]
+    shared = _shared_or_raise(keypair, peer_public)
+    k_i2r, k_r2i = _derive(
+        shared, peer_public, keypair.public, peer_nonce, own_nonce
+    )
+    return peer_public, k_i2r, k_r2i
+
+
 async def connect(
     host: str, port: int, keypair: ExchangeKeyPair, timeout: float = 5.0
 ) -> Channel:
@@ -195,9 +210,8 @@ async def accept(
         own_nonce, peer_public, peer_nonce = await asyncio.wait_for(
             _swap_hello(reader, writer, keypair.public), timeout
         )
-        shared = _shared_or_raise(keypair, peer_public)
-        k_i2r, k_r2i = _derive(
-            shared, peer_public, keypair.public, peer_nonce, own_nonce
+        peer_public, k_i2r, k_r2i = responder_session_keys(
+            keypair, own_nonce, peer_public + peer_nonce
         )
     except Exception:
         writer.close()

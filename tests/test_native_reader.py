@@ -1,0 +1,181 @@
+"""Native channel reader (at2_ingest.cpp reader section + native/reader.py).
+
+Differential against the transport spec: frames encrypted exactly as
+`transport.Channel.send` produces them (u32-LE length || ChaCha20-
+Poly1305 ciphertext, LE-counter nonce) must come back from the C++
+reader thread byte-identical and in order; a tampered frame must kill
+the channel with a protocol-error status (the ChannelClosed parity), and
+the batched wake pipe must signal exactly when frames are pending.
+
+The mesh-level integration is exercised by every multi-node test in the
+suite (the mesh picks the native inbound plane automatically when the
+library is available); here the A/B seam is pinned too: with
+AT2_NO_NATIVE_READER=1 the mesh serves inbound connections on the
+asyncio path and still converges.
+"""
+
+import asyncio
+import itertools
+import os
+import select
+import socket
+import struct
+
+import pytest
+
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.native.reader import (
+    STATUS_EOF,
+    STATUS_OPEN,
+    STATUS_PROTOCOL_ERROR,
+    NativeChannelReader,
+    reader_available,
+)
+
+from conftest import make_net_configs, wait_until
+
+pytestmark = pytest.mark.skipif(
+    not reader_available(), reason="native reader library unavailable"
+)
+
+_ports = itertools.count(23600)
+
+
+def _encrypt_frame(aead, ctr: int, payload: bytes) -> bytes:
+    nonce = struct.pack("<Q", ctr) + b"\x00\x00\x00\x00"
+    ct = aead.encrypt(nonce, payload, None)
+    return struct.pack("<I", len(ct)) + ct
+
+
+def _drain(reader, rfd, timeout=5.0):
+    """Wait for the wake pipe, then take everything pending."""
+    frames = []
+    status = STATUS_OPEN
+    r, _, _ = select.select([rfd], [], [], timeout)
+    assert r, "reader never woke the pipe"
+    os.read(rfd, 65536)
+    while True:
+        batch, status, _drops = reader.take()
+        frames.extend(batch)
+        if not batch:
+            break
+    return frames, status
+
+
+def test_reader_differential_and_tamper():
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    key = bytes(range(32))
+    aead = ChaCha20Poly1305(key)
+    a, b = socket.socketpair()
+    rfd, wfd = os.pipe()
+    os.set_blocking(rfd, False)
+    reader = NativeChannelReader(b.fileno(), key, wfd)
+    try:
+        payloads = [
+            b"",  # empty frame (tag-only ciphertext) is legal
+            b"x" * 1,
+            os.urandom(1000),
+            os.urandom(5 * 1024 * 1024),  # exceeds the 4 MiB take buffer
+        ]
+        blob = b"".join(
+            _encrypt_frame(aead, i, p) for i, p in enumerate(payloads)
+        )
+        a.sendall(blob)
+        got = []
+        while len(got) < len(payloads):
+            frames, status = _drain(reader, rfd)
+            got.extend(frames)
+            assert status == STATUS_OPEN
+        assert got == payloads  # byte-identical, in order
+
+        # tampered ciphertext: channel-fatal protocol error, like
+        # transport.Channel.recv's InvalidTag -> ChannelClosed
+        bad = bytearray(_encrypt_frame(aead, len(payloads), b"evil"))
+        bad[7] ^= 0x01
+        a.sendall(bytes(bad))
+        frames, status = _drain(reader, rfd)
+        assert frames == []
+        assert status == STATUS_PROTOCOL_ERROR
+    finally:
+        reader.stop()
+        os.close(rfd)
+        os.close(wfd)
+        a.close()
+        b.close()
+
+
+def test_reader_clean_eof():
+    key = os.urandom(32)
+    a, b = socket.socketpair()
+    rfd, wfd = os.pipe()
+    os.set_blocking(rfd, False)
+    reader = NativeChannelReader(b.fileno(), key, wfd)
+    try:
+        a.close()
+        frames, status = _drain(reader, rfd)
+        assert frames == []
+        assert status == STATUS_EOF
+    finally:
+        reader.stop()
+        os.close(rfd)
+        os.close(wfd)
+        b.close()
+
+
+def test_reader_oversized_length_is_protocol_error():
+    key = os.urandom(32)
+    a, b = socket.socketpair()
+    rfd, wfd = os.pipe()
+    os.set_blocking(rfd, False)
+    reader = NativeChannelReader(b.fileno(), key, wfd)
+    try:
+        a.sendall(struct.pack("<I", 17 * 1024 * 1024))  # > MAX_FRAME
+        frames, status = _drain(reader, rfd)
+        assert frames == []
+        assert status == STATUS_PROTOCOL_ERROR
+    finally:
+        reader.stop()
+        os.close(rfd)
+        os.close(wfd)
+        a.close()
+        b.close()
+
+
+async def _converge_two_nodes():
+    from at2_node_tpu.client import Client
+    from at2_node_tpu.node.service import Service
+
+    cfgs = make_net_configs(2, _ports, echo_threshold=1, ready_threshold=1)
+    services = [await Service.start(c) for c in cfgs]
+    sender = SignKeyPair.random()
+    recipient = SignKeyPair.random().public
+    try:
+        async with Client(f"http://{cfgs[0].rpc_address}") as client:
+            await client.send_asset(sender, 1, recipient, 10)
+
+            async def committed():
+                for s in services:
+                    if await s.accounts.get_last_sequence(sender.public) < 1:
+                        return False
+                return True
+
+            await wait_until(committed, what="2-node commit")
+        return [s.mesh.stats() for s in services]
+    finally:
+        for s in services:
+            await s.close()
+
+
+@pytest.mark.asyncio
+async def test_mesh_uses_native_readers_and_converges():
+    stats = await _converge_two_nodes()
+    # both nodes accepted their inbound connection onto the native plane
+    assert all(s["native_readers"] >= 1 for s in stats), stats
+
+
+@pytest.mark.asyncio
+async def test_mesh_asyncio_fallback_converges(monkeypatch):
+    monkeypatch.setenv("AT2_NO_NATIVE_READER", "1")
+    stats = await _converge_two_nodes()
+    assert all(s["native_readers"] == 0 for s in stats), stats
