@@ -14,7 +14,12 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <string>
 #include <vector>
+
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
 
 extern "C" {
 int64_t at2_parse_frames(const uint8_t*, const uint64_t*, int64_t, uint8_t*,
@@ -23,6 +28,131 @@ void at2_verify_bulk(const uint8_t*, const uint64_t*, const uint8_t*,
                      const uint64_t*, const uint8_t*, const uint64_t*,
                      int64_t, int64_t, uint8_t*);
 int64_t at2_ingest_row_stride(void);
+void* at2_reader_start(int fd, const uint8_t* key, int wake_fd);
+int64_t at2_reader_take(void*, uint8_t*, int64_t, uint64_t*, int64_t,
+                        int32_t*, uint64_t*);
+void at2_reader_stop(void*);
+
+// encrypt side for the reader test (stable libcrypto ABI)
+typedef struct evp_cipher_st EVP_CIPHER;
+typedef struct evp_cipher_ctx_st EVP_CIPHER_CTX;
+typedef struct engine_st ENGINE;
+const EVP_CIPHER* EVP_chacha20_poly1305(void);
+EVP_CIPHER_CTX* EVP_CIPHER_CTX_new(void);
+void EVP_CIPHER_CTX_free(EVP_CIPHER_CTX*);
+int EVP_EncryptInit_ex(EVP_CIPHER_CTX*, const EVP_CIPHER*, ENGINE*,
+                       const unsigned char*, const unsigned char*);
+int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX*, int, int, void*);
+int EVP_EncryptUpdate(EVP_CIPHER_CTX*, unsigned char*, int*,
+                      const unsigned char*, int);
+int EVP_EncryptFinal_ex(EVP_CIPHER_CTX*, unsigned char*, int*);
+}
+
+static constexpr int kSetIvlen = 0x9, kGetTag = 0x10;
+
+// transport.py wire format: u32-LE ct length || ct (payload + 16B tag),
+// nonce = LE counter || 4 zero bytes
+static std::vector<uint8_t> encrypt_frame(const uint8_t key[32], uint64_t ctr,
+                                          const std::vector<uint8_t>& pt) {
+  EVP_CIPHER_CTX* ctx = EVP_CIPHER_CTX_new();
+  uint8_t iv[12] = {0};
+  for (int i = 0; i < 8; i++) iv[i] = uint8_t(ctr >> (8 * i));
+  std::vector<uint8_t> ct(pt.size() + 16);
+  int outl = 0, finl = 0;
+  bool ok = EVP_EncryptInit_ex(ctx, EVP_chacha20_poly1305(), nullptr, nullptr,
+                               nullptr) == 1 &&
+            EVP_CIPHER_CTX_ctrl(ctx, kSetIvlen, 12, nullptr) == 1 &&
+            EVP_EncryptInit_ex(ctx, nullptr, nullptr, key, iv) == 1 &&
+            EVP_EncryptUpdate(ctx, ct.data(), &outl, pt.data(),
+                              int(pt.size())) == 1 &&
+            EVP_EncryptFinal_ex(ctx, ct.data() + outl, &finl) == 1 &&
+            EVP_CIPHER_CTX_ctrl(ctx, kGetTag, 16,
+                                ct.data() + pt.size()) == 1;
+  EVP_CIPHER_CTX_free(ctx);
+  if (!ok) { std::fprintf(stderr, "encrypt_frame failed\n"); std::exit(1); }
+  std::vector<uint8_t> frame(4 + ct.size());
+  uint32_t len = uint32_t(ct.size());
+  for (int i = 0; i < 4; i++) frame[i] = uint8_t(len >> (8 * i));
+  std::memcpy(frame.data() + 4, ct.data(), ct.size());
+  return frame;
+}
+
+// drive the reader over a socketpair: frames round-trip byte-identical
+// and in order; a tampered frame flips status to 2. Under TSAN this is
+// the race check for the reader thread's queue/wake protocol.
+static int reader_check() {
+  uint8_t key[32];
+  for (int i = 0; i < 32; i++) key[i] = uint8_t(i * 7 + 1);
+  int socks[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, socks) != 0) return 1;
+  int pipefd[2];
+  if (pipe(pipefd) != 0) return 1;
+  void* r = at2_reader_start(socks[1], key, pipefd[1]);
+
+  std::vector<std::vector<uint8_t>> payloads;
+  payloads.push_back({});  // empty frame is legal (tag-only ciphertext)
+  for (int n = 1; n <= 64; n++)
+    payloads.emplace_back(size_t(n * 37 % 3000), uint8_t(n));
+  uint64_t ctr = 0;
+  for (auto& p : payloads) {
+    auto f = encrypt_frame(key, ctr++, p);
+    if (::write(socks[0], f.data(), f.size()) != ssize_t(f.size())) return 1;
+  }
+
+  std::vector<uint8_t> buf(1 << 20);
+  std::vector<uint64_t> offsets(4097);
+  size_t got = 0;
+  int32_t status = 0;
+  uint64_t drops = 0;
+  while (got < payloads.size()) {
+    struct pollfd pfd{pipefd[0], POLLIN, 0};
+    if (poll(&pfd, 1, 5000) <= 0) {
+      std::fprintf(stderr, "reader never woke\n");
+      return 1;
+    }
+    uint8_t scratch[256];
+    (void)!::read(pipefd[0], scratch, sizeof scratch);
+    for (;;) {
+      int64_t n = at2_reader_take(r, buf.data(), int64_t(buf.size()),
+                                  offsets.data(), 4096, &status, &drops);
+      if (n <= 0) break;
+      for (int64_t i = 0; i < n; i++) {
+        const auto& want = payloads[got];
+        size_t len = size_t(offsets[i + 1] - offsets[i]);
+        if (len != want.size() ||
+            std::memcmp(buf.data() + offsets[i], want.data(), len) != 0) {
+          std::fprintf(stderr, "frame %zu mismatch\n", got);
+          return 1;
+        }
+        got++;
+      }
+    }
+  }
+  if (status != 0 || drops != 0) return 1;
+
+  // tamper: one flipped ciphertext bit must kill the channel (status 2)
+  auto evil = encrypt_frame(key, ctr++, {1, 2, 3});
+  evil[9] ^= 1;
+  if (::write(socks[0], evil.data(), evil.size()) != ssize_t(evil.size()))
+    return 1;
+  for (int tries = 0; tries < 50 && status == 0; tries++) {
+    struct pollfd pfd{pipefd[0], POLLIN, 0};
+    poll(&pfd, 1, 200);
+    uint8_t scratch[64];
+    (void)!::read(pipefd[0], scratch, sizeof scratch);
+    at2_reader_take(r, buf.data(), int64_t(buf.size()), offsets.data(), 4096,
+                    &status, &drops);
+  }
+  if (status != 2) {
+    std::fprintf(stderr, "tamper not detected: status=%d\n", status);
+    return 1;
+  }
+  at2_reader_stop(r);
+  close(socks[0]);
+  close(socks[1]);
+  close(pipefd[0]);
+  close(pipefd[1]);
+  return 0;
 }
 
 int main() {
@@ -121,6 +251,12 @@ int main() {
       return 1;
     }
   }
+  // -- native channel reader under TSAN/ASAN --------------------------
+  if (reader_check() != 0) {
+    std::fprintf(stderr, "FAIL: reader check\n");
+    return 1;
+  }
+
   std::printf("sanitize_ingest_test: OK\n");
   return 0;
 }
