@@ -37,6 +37,17 @@ HIST_IDX_REQ = 5  # "send me your commit frontier"
 HIST_IDX = 6  # per-sender committed-sequence frontier
 HIST_REQ = 7  # "send me sender X's committed payloads in [lo, hi]"
 HIST_BATCH = 8  # a batch of committed payloads
+# Batched broadcast plane (see TxBatch below): one broadcast slot carries
+# many client transactions, amortizing the per-slot protocol cost (the
+# ~9 wire messages + ~7 verifies per tx at n=4 that cap the per-tx plane
+# at a few hundred tx/s). Public precedent: Chop Chop's batched atomic
+# broadcast (PAPERS.md); here adapted to AT2's consensus-free model with
+# per-entry endorsement bitmaps so sieve's per-(sender, sequence)
+# equivocation filtering is preserved exactly (stack.py docstring).
+BATCH = 9  # a node-originated batch of client payloads (gossip unit)
+BATCH_ECHO = 10  # Echo over a batch: endorsement bitmap + one signature
+BATCH_READY = 11  # Ready over a batch: same shape as BATCH_ECHO
+BATCH_REQ = 12  # content pull for a quorate batch never gossiped here
 
 _PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
 _ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
@@ -45,6 +56,9 @@ _HIST_IDX_REQ = struct.Struct("<Q")  # nonce
 _HIST_HDR = struct.Struct("<QI")  # nonce, entry count (HIST_IDX / HIST_BATCH)
 _HIST_IDX_ENTRY = struct.Struct("<32sI")  # sender, last committed sequence
 _HIST_REQ = struct.Struct("<Q32sII")  # nonce, sender, from_seq, to_seq
+_BATCH_HDR = struct.Struct("<32sQI64s")  # origin, batch_seq, count, origin sig
+_BATCH_ATT = struct.Struct("<32s32sQ32sI")  # origin, b_origin, b_seq, hash, bm len
+_BATCH_REQ = struct.Struct("<32sQ32s")  # batch origin, batch_seq, hash
 
 PAYLOAD_WIRE = 1 + _PAYLOAD.size
 ATTEST_WIRE = 1 + _ATTEST.size
@@ -52,6 +66,16 @@ REQUEST_WIRE = 1 + _REQUEST.size
 HIST_IDX_REQ_WIRE = 1 + _HIST_IDX_REQ.size
 HIST_REQ_WIRE = 1 + _HIST_REQ.size
 HIST_HDR_WIRE = 1 + _HIST_HDR.size  # variable records: header + entries
+ENTRY_WIRE = _PAYLOAD.size  # one batch entry = one 140-byte payload body
+BATCH_HDR_WIRE = 1 + _BATCH_HDR.size  # variable: header + count entries
+BATCH_ATT_WIRE = 1 + _BATCH_ATT.size + 64  # variable: + bitmap before sig
+BATCH_REQ_WIRE = 1 + _BATCH_REQ.size
+
+# Hard cap on entries per batch (bounds bitmap width, parse amplification,
+# and the per-slot verify burst); the ingress batcher flushes well below
+# it (node/config.py BatchingConfig.max_entries).
+MAX_BATCH_ENTRIES = 1024
+MAX_BITMAP_BYTES = MAX_BATCH_ENTRIES // 8
 
 # A legitimate frame coalesces at most MAX_BATCH_MSGS = 1024 messages
 # (net/peers.py); 4x that is the malformed bound. Bounds the parse
@@ -61,6 +85,9 @@ MAX_MSGS_PER_FRAME = 4096
 
 _ECHO_TAG = b"at2-node-tpu/echo/v1"
 _READY_TAG = b"at2-node-tpu/ready/v1"
+_BATCH_TAG = b"at2-node-tpu/batch/v1"
+_BECHO_TAG = b"at2-node-tpu/batch-echo/v1"
+_BREADY_TAG = b"at2-node-tpu/batch-ready/v1"
 
 
 class WireError(Exception):
@@ -267,6 +294,201 @@ class HistoryBatch:
         return HistoryBatch(nonce, payloads)
 
 
+@dataclass(frozen=True)
+class TxBatch:
+    """A node-originated batch of client transactions: ONE broadcast slot
+    ((origin node, batch_seq)) carrying many independently client-signed
+    transfers. This is the protocol lever that amortizes the per-slot
+    broadcast cost (gossip relay + n Echo + n Ready signatures) over
+    ``count`` transactions — the reference broadcasts one transaction per
+    sieve payload (`/root/reference/src/bin/server/rpc.rs:275-284`); this
+    build generalizes that surface (Chop Chop precedent, PAPERS.md).
+
+    ``entries_raw`` is ``count`` back-to-back 140-byte payload bodies
+    (the exact GOSSIP body layout), so entries decode with the same
+    structs, the catchup/history plane stores them unchanged, and the
+    per-entry *client* signatures ride inside — verified in the same bulk
+    ``verify_many`` call as the one origin signature.
+
+    The origin signs (tag || origin || batch_seq || sha256(entries_raw)):
+    relayed batches cannot be forged under another node's identity, and a
+    byzantine origin equivocating two batch contents for one batch_seq is
+    filtered exactly like a per-tx equivocation (stack.py binds each slot
+    to the first content echoed)."""
+
+    origin: bytes  # sign key of the batching node
+    batch_seq: int  # u64; unique per origin (time-seeded, see service.py)
+    entries_raw: bytes  # count x 140-byte payload bodies
+    signature: bytes  # origin's ed25519 over signing_bytes()
+
+    @property
+    def slot(self) -> tuple:
+        return (self.origin, self.batch_seq)
+
+    @property
+    def count(self) -> int:
+        return len(self.entries_raw) // ENTRY_WIRE
+
+    def entry(self, i: int) -> Payload:
+        return Payload.decode_body(
+            self.entries_raw[i * ENTRY_WIRE : (i + 1) * ENTRY_WIRE]
+        )
+
+    def entry_bytes(self, i: int) -> bytes:
+        return self.entries_raw[i * ENTRY_WIRE : (i + 1) * ENTRY_WIRE]
+
+    def entries(self) -> list:
+        """All entries decoded (memoized: echo and delivery both need
+        them; one decode pass per batch per node)."""
+        cached = self.__dict__.get("_entries")
+        if cached is None:
+            cached = [
+                Payload(sender, seq, ThinTransaction(recipient, amount), sig)
+                for sender, seq, recipient, amount, sig in _PAYLOAD.iter_unpack(
+                    self.entries_raw
+                )
+            ]
+            object.__setattr__(self, "_entries", cached)
+        return cached
+
+    def signing_bytes(self) -> bytes:
+        return (
+            _BATCH_TAG
+            + self.origin
+            + struct.pack("<Q", self.batch_seq)
+            + hashlib.sha256(self.entries_raw).digest()
+        )
+
+    @classmethod
+    def create(
+        cls, keypair, batch_seq: int, entries_raw: bytes
+    ) -> "TxBatch":
+        """Build and origin-sign a batch (the one construction path the
+        ingress batcher and bench tools share)."""
+        unsigned = cls(keypair.public, batch_seq, entries_raw, b"\0" * 64)
+        return cls(
+            keypair.public,
+            batch_seq,
+            entries_raw,
+            keypair.sign(unsigned.signing_bytes()),
+        )
+
+    def content_hash(self) -> bytes:
+        """The batch content identity Echo/Ready bitmaps attest to (the
+        whole encoded body, signature included — same convention as
+        Payload.content_hash)."""
+        cached = self.__dict__.get("_chash")
+        if cached is None:
+            cached = hashlib.sha256(self.encode()[1:]).digest()
+            object.__setattr__(self, "_chash", cached)
+        return cached
+
+    def encode(self) -> bytes:
+        cached = self.__dict__.get("_encoded")
+        if cached is None:
+            cached = (
+                bytes([BATCH])
+                + _BATCH_HDR.pack(
+                    self.origin, self.batch_seq, self.count, self.signature
+                )
+                + self.entries_raw
+            )
+            object.__setattr__(self, "_encoded", cached)
+        return cached
+
+    @staticmethod
+    def decode_body(body: bytes) -> "TxBatch":
+        origin, batch_seq, count, sig = _BATCH_HDR.unpack_from(body)
+        entries = body[_BATCH_HDR.size :]
+        if len(entries) != count * ENTRY_WIRE:
+            raise WireError("batch entry count mismatch")
+        return TxBatch(origin, batch_seq, entries, sig)
+
+
+@dataclass(frozen=True)
+class BatchAttestation:
+    """An Echo or Ready over a batch: ONE signature endorsing a subset of
+    the batch's entries, given by ``bitmap`` (little-endian bit i =
+    entry i). Bitmaps let a node endorse exactly the entries that pass
+    its per-(sender, sequence) equivocation registry, so one conflicting
+    entry cannot poison the rest of the batch, and per-entry quorum
+    counting preserves sieve/contagion semantics entry-by-entry
+    (stack.py `_BatchState`). Ready bitmaps are monotone: an origin may
+    re-attest with a superset as more entries reach Echo quorum."""
+
+    phase: int  # BATCH_ECHO or BATCH_READY
+    origin: bytes  # attesting node's sign key
+    batch_origin: bytes
+    batch_seq: int
+    batch_hash: bytes  # TxBatch.content_hash()
+    bitmap: bytes  # little-endian entry endorsement bits
+    signature: bytes
+
+    @staticmethod
+    def signing_bytes(
+        phase: int, batch_origin: bytes, batch_seq: int, batch_hash: bytes,
+        bitmap: bytes,
+    ) -> bytes:
+        tag = _BECHO_TAG if phase == BATCH_ECHO else _BREADY_TAG
+        return (
+            tag
+            + batch_origin
+            + struct.pack("<Q", batch_seq)
+            + batch_hash
+            + bitmap
+        )
+
+    def to_sign(self) -> bytes:
+        return self.signing_bytes(
+            self.phase, self.batch_origin, self.batch_seq, self.batch_hash,
+            self.bitmap,
+        )
+
+    def encode(self) -> bytes:
+        return (
+            bytes([self.phase])
+            + _BATCH_ATT.pack(
+                self.origin,
+                self.batch_origin,
+                self.batch_seq,
+                self.batch_hash,
+                len(self.bitmap),
+            )
+            + self.bitmap
+            + self.signature
+        )
+
+    @staticmethod
+    def decode_body(phase: int, body: bytes) -> "BatchAttestation":
+        origin, b_origin, b_seq, b_hash, bm_len = _BATCH_ATT.unpack_from(body)
+        bitmap = body[_BATCH_ATT.size : _BATCH_ATT.size + bm_len]
+        sig = body[_BATCH_ATT.size + bm_len :]
+        if len(bitmap) != bm_len or len(sig) != 64:
+            raise WireError("truncated batch attestation")
+        return BatchAttestation(phase, origin, b_origin, b_seq, b_hash, bitmap, sig)
+
+
+@dataclass(frozen=True)
+class BatchContentRequest:
+    """Pull request for a batch whose Ready quorum was observed but whose
+    gossip never arrived (the batch-plane twin of ContentRequest;
+    unsigned, accepted only over authenticated channels)."""
+
+    batch_origin: bytes
+    batch_seq: int
+    batch_hash: bytes
+
+    def encode(self) -> bytes:
+        return bytes([BATCH_REQ]) + _BATCH_REQ.pack(
+            self.batch_origin, self.batch_seq, self.batch_hash
+        )
+
+    @staticmethod
+    def decode_body(body: bytes) -> "BatchContentRequest":
+        b_origin, b_seq, b_hash = _BATCH_REQ.unpack(body)
+        return BatchContentRequest(b_origin, b_seq, b_hash)
+
+
 def parse_frame(frame: bytes) -> list:
     """Split a frame into messages (frames may coalesce many)."""
     out = []
@@ -316,6 +538,40 @@ def parse_frame(frame: bytes) -> list:
             else:
                 out.append(HistoryBatch.decode_body(nonce, body))
             view = view[total:]
+        elif kind == BATCH:
+            if len(view) < BATCH_HDR_WIRE:
+                raise WireError("truncated batch header")
+            _, _, count, _ = _BATCH_HDR.unpack_from(view, 1)
+            if not 1 <= count <= MAX_BATCH_ENTRIES:
+                raise WireError("batch entry count out of range")
+            total = BATCH_HDR_WIRE + count * ENTRY_WIRE
+            if len(view) < total:
+                raise WireError("truncated batch entries")
+            out.append(TxBatch.decode_body(bytes(view[1:total])))
+            view = view[total:]
+        elif kind in (BATCH_ECHO, BATCH_READY):
+            if len(view) < BATCH_ATT_WIRE:
+                raise WireError("truncated batch attestation")
+            bm_len = int.from_bytes(
+                bytes(view[1 + _BATCH_ATT.size - 4 : 1 + _BATCH_ATT.size]),
+                "little",
+            )
+            if bm_len > MAX_BITMAP_BYTES:
+                raise WireError("batch attestation bitmap too wide")
+            total = BATCH_ATT_WIRE + bm_len
+            if len(view) < total:
+                raise WireError("truncated batch attestation bitmap")
+            out.append(
+                BatchAttestation.decode_body(kind, bytes(view[1:total]))
+            )
+            view = view[total:]
+        elif kind == BATCH_REQ:
+            if len(view) < BATCH_REQ_WIRE:
+                raise WireError("truncated batch content request")
+            out.append(
+                BatchContentRequest.decode_body(bytes(view[1:BATCH_REQ_WIRE]))
+            )
+            view = view[BATCH_REQ_WIRE:]
         else:
             raise WireError(f"unknown message kind {kind}")
     return out

@@ -34,6 +34,39 @@ threshold to that count, so an empty peer list degenerates to immediate
 self-delivery, matching the reference's standalone-node test
 `/root/reference/tests/server-config-resolve-addrs`).
 
+**Batched broadcast slots** (the 10k-tx/s lever): alongside the per-tx
+plane above, a node may gossip a :class:`TxBatch` — ONE slot
+((origin node, batch_seq)) carrying up to 1024 client transactions —
+amortizing the per-slot protocol cost (1 gossip relay + n Echo + n Ready
+messages and signatures) over the whole batch. The reference broadcasts
+one transaction per sieve payload
+(`/root/reference/src/bin/server/rpc.rs:275-284`); Chop Chop (PAPERS.md)
+is the public precedent for batching the broadcast unit. Chop Chop sits
+on a total-order layer, where batch-level conflict resolution is free;
+AT2 is consensus-free, so batch slots alone would lose sieve's
+per-(sender, sequence) guarantee — a byzantine CLIENT racing conflicting
+same-sequence transfers into two different honest nodes' batches could
+commit differently on different correct nodes. This design closes that
+hole with **per-entry endorsement bitmaps**:
+
+* every node keeps an *entry registry* binding each (client sender,
+  sequence) to the FIRST 140-byte entry content it echo-endorsed, across
+  BOTH planes (per-tx echoes bind it too);
+* a batch Echo/Ready is one signature over (batch hash, bitmap) where
+  bit i endorses entry i — a node endorses exactly the entries whose
+  client signature verified and whose registry binding is
+  unbound-or-equal, so one conflicting entry never poisons its batch;
+* quorum is counted PER ENTRY (vectorized: per-origin monotone bitmap
+  ints, numpy unpackbits into count vectors), so an entry is delivered
+  exactly when `echo/ready_threshold` distinct nodes endorsed *it* —
+  with intersecting quorums (threshold > n/2) two conflicting contents
+  for one (sender, sequence) can never both quorate, the same argument
+  as per-tx sieve;
+* Ready bitmaps are monotone (an origin re-attests with a superset as
+  more entries reach Echo quorum); delivered entries feed the service's
+  commit heap as ordinary Payloads, so the ledger, catchup, and history
+  planes are unchanged.
+
 Verification is the hot path (BASELINE north star): each worker drains a
 CHUNK of the inbox per iteration and runs a three-stage pipeline —
 (1) synchronous pre-checks (dedup, slot caps, per-origin single-vote) that
@@ -54,20 +87,29 @@ import time
 from collections import defaultdict
 from typing import Dict, Optional, Set, Tuple
 
+import numpy as np
+
 from ..crypto.keys import SignKeyPair
 from ..crypto.verifier import Verifier
 from ..net.peers import Mesh, Peer
 from .messages import (
+    BATCH,
+    BATCH_ECHO,
+    BATCH_READY,
     ECHO,
     GOSSIP,
+    MAX_BITMAP_BYTES,
     READY,
     Attestation,
+    BatchAttestation,
+    BatchContentRequest,
     ContentRequest,
     HistoryBatch,
     HistoryIndex,
     HistoryIndexRequest,
     HistoryRequest,
     Payload,
+    TxBatch,
     WireError,
     parse_frame,
 )
@@ -138,6 +180,121 @@ class _BoundedSet:
         return len(self._items)
 
 
+class _BoundedDict:
+    """Insertion-ordered dict with FIFO eviction at a fixed capacity
+    (the mapping twin of :class:`_BoundedSet`)."""
+
+    __slots__ = ("_cap", "_items")
+
+    def __init__(self, cap: int) -> None:
+        self._cap = cap
+        self._items: Dict = {}
+
+    def get(self, key, default=None):
+        return self._items.get(key, default)
+
+    def put(self, key, value) -> None:
+        if key not in self._items:
+            if len(self._items) >= self._cap:
+                self._items.pop(next(iter(self._items)))
+        self._items[key] = value
+
+    def __contains__(self, key) -> bool:
+        return key in self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+_EMPTY_COUNTS = np.zeros(0, dtype=np.int32)
+
+
+def _quorate_mask(counts: np.ndarray, threshold: int, nbits: int) -> int:
+    """Bitmap int of entries whose vote count reached the threshold."""
+    if nbits <= 0:
+        return 0
+    if threshold <= 0:
+        return (1 << nbits) - 1
+    n = min(len(counts), nbits)
+    if n == 0:
+        return 0
+    mask = counts[:n] >= threshold
+    return int.from_bytes(
+        np.packbits(mask, bitorder="little").tobytes(), "little"
+    )
+
+
+class _BatchVotes:
+    """Per-(content hash, phase) vote accumulator: per-origin MONOTONE
+    endorsement bitmaps (ints) plus a vectorized per-entry count vector.
+    ``add`` is the only mutator: it ORs an origin's new bitmap in and
+    bumps the counts at every newly-set bit position (numpy unpackbits —
+    one vectorized op per attestation, not per entry)."""
+
+    __slots__ = ("by_origin", "counts")
+
+    def __init__(self) -> None:
+        self.by_origin: Dict[bytes, int] = {}
+        self.counts = _EMPTY_COUNTS
+
+    def add(self, origin: bytes, bits: int, nbits: int) -> bool:
+        """Returns True when the origin contributed at least one new bit."""
+        old = self.by_origin.get(origin, 0)
+        new = bits & ~old
+        if not new:
+            return False
+        self.by_origin[origin] = old | bits
+        if len(self.counts) < nbits:
+            grown = np.zeros(nbits, dtype=np.int32)
+            grown[: len(self.counts)] = self.counts
+            self.counts = grown
+        delta = np.unpackbits(
+            np.frombuffer(
+                new.to_bytes((nbits + 7) // 8, "little"), dtype=np.uint8
+            ),
+            bitorder="little",
+        )[:nbits]
+        self.counts[:nbits] += delta
+        return True
+
+
+class _BatchState:
+    """Broadcast state of one batch slot ((origin node, batch_seq)) —
+    the batched twin of :class:`_SlotState`, with per-entry vote vectors
+    instead of per-slot origin sets."""
+
+    __slots__ = (
+        "created",
+        "content_requested_at",
+        "contents",
+        "echoed_hash",
+        "echo_by_origin",
+        "ready_by_origin",
+        "echo_votes",
+        "ready_votes",
+        "ready_sent_bits",
+        "delivered_bits",
+        "delivered_all",
+        "nbits",
+    )
+
+    def __init__(self) -> None:
+        self.created = time.monotonic()
+        self.content_requested_at = 0.0
+        self.contents: Dict[bytes, TxBatch] = {}  # batch hash -> batch
+        self.echoed_hash: Optional[bytes] = None  # first content echoed here
+        # first vote per origin per phase binds that origin to ONE batch
+        # content (node-level equivocation guard, like *_by_origin above)
+        self.echo_by_origin: Dict[bytes, bytes] = {}
+        self.ready_by_origin: Dict[bytes, bytes] = {}
+        self.echo_votes: Dict[bytes, _BatchVotes] = {}  # batch hash -> votes
+        self.ready_votes: Dict[bytes, _BatchVotes] = {}
+        self.ready_sent_bits: Dict[bytes, int] = {}  # hash -> our sent bits
+        self.delivered_bits: Dict[bytes, int] = {}  # hash -> delivered bits
+        self.delivered_all = False  # some content fully delivered
+        self.nbits = 0  # widest entry count seen (content or bitmap bound)
+
+
 class _SlotState:
     __slots__ = (
         "contents",
@@ -195,6 +352,13 @@ class Broadcast:
         self.workers = workers
         self.delivered: asyncio.Queue = asyncio.Queue()
         self._slots: Dict[Slot, _SlotState] = {}
+        # batched plane (module docstring): batch slots keyed
+        # (origin node sign key, batch_seq); the entry registry binds each
+        # (client sender, client seq) to the first echo-endorsed 140-byte
+        # entry content ACROSS both planes — sieve's per-slot guarantee
+        self._batch_slots: Dict[Tuple[bytes, int], _BatchState] = {}
+        self._delivered_batch_slots = _BoundedSet(DEDUP_CAP)
+        self._entry_registry = _BoundedDict(DEDUP_CAP)
         self._inbox: asyncio.Queue = asyncio.Queue(maxsize=65536)
         # The inbox holds RAW frames (parsed in the worker chunk stage),
         # each up to transport MAX_FRAME (16 MiB) — so the entry-count
@@ -226,6 +390,10 @@ class Broadcast:
             "content_req_tx": 0,
             "content_req_rx": 0,
             "content_served": 0,
+            "batch_rx": 0,
+            "batch_echo_rx": 0,
+            "batch_ready_rx": 0,
+            "batch_entries_delivered": 0,
         }
 
     async def start(self) -> None:
@@ -270,6 +438,11 @@ class Broadcast:
         reference: `handle.broadcast`, rpc.rs:275-284)."""
         await self._inbox.put((None, payload))
 
+    async def broadcast_batch(self, batch: TxBatch) -> None:
+        """Local submission of a signed batch slot (the service's ingress
+        batcher calls this; see node/service.py `_flush_batch`)."""
+        await self._inbox.put((None, batch))
+
     # -- workers ----------------------------------------------------------
 
     async def _gc_loop(self) -> None:
@@ -296,6 +469,26 @@ class Broadcast:
                             and chash not in state.contents
                         ):
                             self._request_content(slot, state, chash)
+            for slot in list(self._batch_slots):
+                bstate = self._batch_slots[slot]
+                age = now - bstate.created
+                if bstate.delivered_all and age > DELIVERED_RETENTION:
+                    self._delivered_batch_slots.add(slot)
+                    del self._batch_slots[slot]
+                elif age > SLOT_MAX_AGE:
+                    if not bstate.delivered_all:
+                        self._undelivered -= 1
+                    del self._batch_slots[slot]
+                elif not bstate.delivered_all:
+                    # retry the batch pull when quorate entries await content
+                    for chash, rv in bstate.ready_votes.items():
+                        if chash in bstate.contents:
+                            continue
+                        quorate = _quorate_mask(
+                            rv.counts, self.ready_threshold, bstate.nbits
+                        )
+                        if quorate & ~bstate.delivered_bits.get(chash, 0):
+                            self._request_batch_content(slot, bstate, chash)
 
     async def _worker(self) -> None:
         while True:
@@ -367,9 +560,11 @@ class Broadcast:
 
     async def _process_chunk(self, chunk) -> None:
         """Three stages (module docstring): sync pre-checks -> one bulk
-        verify -> sync state transitions (re-validated against races)."""
+        verify -> sync state transitions (re-validated against races).
+        Actions carry how many verify items they claimed: a TxBatch puts
+        1 (origin) + count (client) signatures into the SAME bulk call."""
         to_verify = []
-        actions = []
+        actions = []  # (kind, msg, n_sigs)
         for peer, msg in chunk:
             if isinstance(msg, Payload):
                 if self._pre_gossip(msg):
@@ -380,9 +575,26 @@ class Broadcast:
                             msg.signature,
                         )
                     )
-                    actions.append((GOSSIP, msg))
+                    actions.append((GOSSIP, msg, 1))
+            elif isinstance(msg, TxBatch):
+                if self._pre_batch(msg):
+                    to_verify.append(
+                        (msg.origin, msg.signing_bytes(), msg.signature)
+                    )
+                    entries = msg.entries()
+                    to_verify.extend(
+                        (e.sender, e.transaction.signing_bytes(), e.signature)
+                        for e in entries
+                    )
+                    actions.append((BATCH, msg, 1 + len(entries)))
+            elif isinstance(msg, BatchAttestation):
+                if self._pre_batch_attestation(msg):
+                    to_verify.append((msg.origin, msg.to_sign(), msg.signature))
+                    actions.append((msg.phase, msg, 1))
             elif isinstance(msg, ContentRequest):
                 self._on_request(peer, msg)
+            elif isinstance(msg, BatchContentRequest):
+                self._on_batch_request(peer, msg)
             elif isinstance(msg, _CATCHUP_KINDS):
                 # synchronous handler (service-side bookkeeping / replies
                 # via mesh.send); heavy work happens in the service's
@@ -395,11 +607,16 @@ class Broadcast:
             else:
                 if self._pre_attestation(msg):
                     to_verify.append((msg.origin, msg.to_sign(), msg.signature))
-                    actions.append((msg.phase, msg))
+                    actions.append((msg.phase, msg, 1))
         if not to_verify:
             return
         results = await self.verifier.verify_many(to_verify)
-        for (kind, msg), ok in zip(actions, results):
+        idx = 0
+        for kind, msg, n_sigs in actions:
+            ok = results[idx]
+            if kind == BATCH:
+                entry_oks = results[idx + 1 : idx + n_sigs]
+            idx += n_sigs
             if not ok:
                 self.stats["invalid_sig"] += 1
                 if kind == GOSSIP:
@@ -408,15 +625,29 @@ class Broadcast:
                         msg.sender.hex()[:16],
                         msg.sequence,
                     )
+                elif kind == BATCH:
+                    logger.warning(
+                        "invalid batch origin signature from %s",
+                        msg.origin.hex()[:16],
+                    )
                 else:
                     logger.warning(
                         "invalid %s signature from %s",
-                        "echo" if kind == ECHO else "ready",
+                        {
+                            ECHO: "echo",
+                            READY: "ready",
+                            BATCH_ECHO: "batch-echo",
+                            BATCH_READY: "batch-ready",
+                        }.get(kind, "attestation"),
                         msg.origin.hex()[:16],
                     )
                 continue
             if kind == GOSSIP:
                 self._post_gossip(msg)
+            elif kind == BATCH:
+                self._post_batch(msg, entry_oks)
+            elif kind in (BATCH_ECHO, BATCH_READY):
+                self._post_batch_attestation(msg)
             else:
                 self._post_attestation(msg)
 
@@ -528,10 +759,21 @@ class Broadcast:
         state.contents[chash] = payload
         # murmur: relay to everyone (gossip_size = full network)
         self.mesh.broadcast(payload.encode())
-        # sieve: echo only the FIRST content seen for this slot
+        # sieve: echo only the FIRST content seen for this slot — and only
+        # if the cross-plane entry registry agrees (a conflicting content
+        # for this (sender, seq) may already be bound via a BATCH entry;
+        # endorsing both here and there would let two intersecting quorums
+        # form for different contents — module docstring)
         if state.echoed_hash is None:
-            state.echoed_hash = chash
-            self._send_attestation(ECHO, payload.sender, payload.sequence, chash)
+            body = payload.encode()[1:]
+            bound = self._entry_registry.get(slot)
+            if bound is None or bound == body:
+                if bound is None:
+                    self._entry_registry.put(slot, body)
+                state.echoed_hash = chash
+                self._send_attestation(
+                    ECHO, payload.sender, payload.sequence, chash
+                )
         self._advance(slot, state, chash)
 
     def _post_attestation(self, att: Attestation) -> None:
@@ -589,6 +831,278 @@ class Broadcast:
             state = self._slots[slot] = _SlotState()
             self._undelivered += 1
         return state
+
+    # -- batched plane (module docstring) ---------------------------------
+
+    def _new_or_existing_batch_slot(self, slot) -> _BatchState:
+        state = self._batch_slots.get(slot)
+        if state is None:
+            state = self._batch_slots[slot] = _BatchState()
+            self._undelivered += 1
+        return state
+
+    def _pre_batch(self, batch: TxBatch) -> bool:
+        self.stats["batch_rx"] += 1
+        # batch slots exist only under KNOWN node identities (peers or
+        # self) — an unauthenticated key cannot open batch slots at all
+        if (
+            batch.origin not in self.mesh.by_sign
+            and batch.origin != self.keypair.public
+        ):
+            logger.warning(
+                "batch from unknown origin %s", batch.origin.hex()[:16]
+            )
+            return False
+        slot = batch.slot
+        if slot in self._delivered_batch_slots:
+            return False
+        if slot not in self._batch_slots and self._undelivered >= MAX_LIVE_SLOTS:
+            self.stats["slots_dropped"] += 1
+            return False
+        chash = batch.content_hash()
+        key = (BATCH, slot, chash)  # distinct key-space from per-tx gossip
+        if key in self._gossip_seen:
+            return False
+        state = self._batch_slots.get(slot)
+        if state is not None:
+            if chash in state.contents:
+                return False
+            # same cap/NOTE discipline as _pre_gossip: capacity rejections
+            # stay retryable, quorate content is always admitted
+            if (
+                len(state.contents) >= MAX_CONTENTS_PER_SLOT
+                and not self._batch_content_wanted(state, chash)
+            ):
+                return False
+        self._gossip_seen.add(key)
+        return True
+
+    def _batch_content_wanted(self, state: _BatchState, chash: bytes) -> bool:
+        rv = state.ready_votes.get(chash)
+        if rv is not None and len(rv.by_origin) >= max(self.ready_threshold, 1):
+            return True
+        ev = state.echo_votes.get(chash)
+        return ev is not None and len(ev.by_origin) >= max(self.echo_threshold, 1)
+
+    def _pre_batch_attestation(self, att: BatchAttestation) -> bool:
+        key = "batch_echo_rx" if att.phase == BATCH_ECHO else "batch_ready_rx"
+        self.stats[key] += 1
+        if att.origin not in self.mesh.by_sign:
+            logger.warning(
+                "batch attestation from unknown origin %s",
+                att.origin.hex()[:16],
+            )
+            return False
+        if len(att.bitmap) > MAX_BITMAP_BYTES or not att.bitmap:
+            return False
+        slot = (att.batch_origin, att.batch_seq)
+        if slot in self._delivered_batch_slots:
+            return False
+        if slot not in self._batch_slots and self._undelivered >= MAX_LIVE_SLOTS:
+            self.stats["slots_dropped"] += 1
+            return False
+        seen_key = (
+            att.phase, att.origin, slot, att.batch_hash, att.bitmap,
+            att.signature,
+        )
+        if seen_key in self._attest_seen:
+            return False
+        self._attest_seen.add(seen_key)
+        state = self._batch_slots.get(slot)
+        if state is not None:
+            by_origin = (
+                state.echo_by_origin
+                if att.phase == BATCH_ECHO
+                else state.ready_by_origin
+            )
+            bound = by_origin.get(att.origin)
+            if bound is not None and bound != att.batch_hash:
+                return False  # origin already voted for a different content
+            # monotone bitmaps: a subset of already-counted bits is noise;
+            # don't spend a verify on it
+            votes = (
+                state.echo_votes
+                if att.phase == BATCH_ECHO
+                else state.ready_votes
+            ).get(att.batch_hash)
+            if votes is not None:
+                old = votes.by_origin.get(att.origin, 0)
+                if int.from_bytes(att.bitmap, "little") & ~old == 0:
+                    return False
+        return True
+
+    def _post_batch(self, batch: TxBatch, entry_oks) -> None:
+        slot = batch.slot
+        if slot in self._delivered_batch_slots:
+            return
+        chash = batch.content_hash()
+        state = self._new_or_existing_batch_slot(slot)
+        if chash in state.contents:
+            return
+        if (
+            len(state.contents) >= MAX_CONTENTS_PER_SLOT
+            and not self._batch_content_wanted(state, chash)
+        ):
+            self._gossip_seen.discard((BATCH, slot, chash))
+            return
+        state.contents[chash] = batch
+        state.nbits = max(state.nbits, batch.count)
+        # murmur: relay the batch to everyone
+        self.mesh.broadcast(batch.encode())
+        # sieve, batched: echo only the FIRST batch content for this slot,
+        # endorsing exactly the entries whose client signature verified
+        # AND whose (sender, seq) registry binding is unbound-or-equal
+        if state.echoed_hash is None:
+            state.echoed_hash = chash
+            bits = 0
+            for i, ok in enumerate(entry_oks):
+                if not ok:
+                    self.stats["invalid_sig"] += 1
+                    continue
+                entry = batch.entry_bytes(i)
+                ekey = (entry[:32], int.from_bytes(entry[32:36], "little"))
+                bound = self._entry_registry.get(ekey)
+                if bound is None:
+                    self._entry_registry.put(ekey, entry)
+                elif bound != entry:
+                    continue  # conflicting content already endorsed
+                bits |= 1 << i
+            if bits:
+                self._send_batch_attestation(
+                    BATCH_ECHO, slot, chash, bits, batch.count
+                )
+        self._advance_batch(slot, state, chash)
+
+    def _post_batch_attestation(self, att: BatchAttestation) -> None:
+        slot = (att.batch_origin, att.batch_seq)
+        if slot in self._delivered_batch_slots:
+            return
+        state = self._new_or_existing_batch_slot(slot)
+        by_origin = (
+            state.echo_by_origin
+            if att.phase == BATCH_ECHO
+            else state.ready_by_origin
+        )
+        bound = by_origin.get(att.origin)
+        if bound is not None and bound != att.batch_hash:
+            return
+        by_origin[att.origin] = att.batch_hash
+        votes_map = (
+            state.echo_votes if att.phase == BATCH_ECHO else state.ready_votes
+        )
+        votes = votes_map.get(att.batch_hash)
+        if votes is None:
+            votes = votes_map[att.batch_hash] = _BatchVotes()
+        nbits = len(att.bitmap) * 8
+        if votes.add(att.origin, int.from_bytes(att.bitmap, "little"), nbits):
+            state.nbits = max(state.nbits, nbits)
+            self._advance_batch(slot, state, att.batch_hash)
+
+    def _send_batch_attestation(
+        self, phase: int, slot, chash: bytes, bits: int, nbits: int
+    ) -> None:
+        bitmap = bits.to_bytes((nbits + 7) // 8, "little")
+        sig = self.keypair.sign(
+            BatchAttestation.signing_bytes(phase, slot[0], slot[1], chash, bitmap)
+        )
+        att = BatchAttestation(
+            phase, self.keypair.public, slot[0], slot[1], chash, bitmap, sig
+        )
+        self.mesh.broadcast(att.encode())
+
+    def _advance_batch(self, slot, state: _BatchState, chash: bytes) -> None:
+        """Drive per-entry phase transitions for one batch content."""
+        batch = state.contents.get(chash)
+        nbits = batch.count if batch is not None else state.nbits
+        if nbits <= 0:
+            return
+        full = (1 << nbits) - 1
+        ev = state.echo_votes.get(chash)
+        rv = state.ready_votes.get(chash)
+        echo_q = _quorate_mask(
+            ev.counts if ev is not None else _EMPTY_COUNTS,
+            self.echo_threshold,
+            nbits,
+        )
+        ready_q = _quorate_mask(
+            rv.counts if rv is not None else _EMPTY_COUNTS,
+            self.ready_threshold,
+            nbits,
+        )
+        # Ready an entry on its Echo quorum (sieve-deliver) OR on a full
+        # Ready quorum (contagion amplification) — cumulative bitmap so a
+        # late joiner always receives a superset of earlier attestations
+        sent = state.ready_sent_bits.get(chash, 0)
+        to_ready = (echo_q | ready_q) & ~sent & full
+        if to_ready:
+            sent |= to_ready
+            state.ready_sent_bits[chash] = sent
+            self._send_batch_attestation(
+                BATCH_READY, slot, chash, sent, nbits
+            )
+        # deliver: entry-level Ready quorum, our own Ready cast, content
+        # known, not yet delivered
+        deliverable = (
+            ready_q & sent & ~state.delivered_bits.get(chash, 0) & full
+        )
+        if not deliverable:
+            return
+        if batch is None:
+            # quorate but the gossip never landed here: pull the batch
+            self._request_batch_content(slot, state, chash)
+            return
+        state.delivered_bits[chash] = (
+            state.delivered_bits.get(chash, 0) | deliverable
+        )
+        entries = batch.entries()
+        d = deliverable
+        while d:
+            lsb = d & -d
+            i = lsb.bit_length() - 1
+            self.delivered.put_nowait(entries[i])
+            self.stats["batch_entries_delivered"] += 1
+            d ^= lsb
+        if state.delivered_bits[chash] == (1 << batch.count) - 1:
+            if not state.delivered_all:
+                state.delivered_all = True
+                self._undelivered -= 1
+                self.stats["delivered"] += 1
+
+    def _on_batch_request(
+        self, peer: Optional[Peer], req: BatchContentRequest
+    ) -> None:
+        """Serve a peer's batch content pull (channel-authenticated)."""
+        self.stats["content_req_rx"] += 1
+        if peer is None:
+            return
+        state = self._batch_slots.get((req.batch_origin, req.batch_seq))
+        if state is None:
+            return
+        batch = state.contents.get(req.batch_hash)
+        if batch is not None:
+            self.stats["content_served"] += 1
+            self.mesh.send(peer, batch.encode())
+
+    def _request_batch_content(
+        self, slot, state: _BatchState, chash: bytes
+    ) -> None:
+        now = time.monotonic()
+        if now - state.content_requested_at < REQUEST_RETRY:
+            return
+        state.content_requested_at = now
+        self.stats["content_req_tx"] += 1
+        frame = BatchContentRequest(slot[0], slot[1], chash).encode()
+        rv = state.ready_votes.get(chash)
+        targets = [
+            self.mesh.by_sign[origin]
+            for origin in (rv.by_origin if rv is not None else ())
+            if origin in self.mesh.by_sign
+        ]
+        if targets:
+            for peer in targets:
+                self.mesh.send(peer, frame)
+        else:
+            self.mesh.broadcast(frame)
 
     # -- state transitions (synchronous; no awaits) -----------------------
 

@@ -157,6 +157,7 @@ void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
 
 constexpr uint8_t kGossip = 1, kEcho = 2, kReady = 3, kRequest = 4;
 constexpr uint8_t kHistIdxReq = 5, kHistIdx = 6, kHistReq = 7, kHistBatch = 8;
+constexpr uint8_t kBatch = 9, kBatchEcho = 10, kBatchReady = 11, kBatchReq = 12;
 constexpr size_t kPayloadWire = 1 + 140;
 constexpr size_t kAttestWire = 1 + 164;
 constexpr size_t kRequestWire = 1 + 68;
@@ -165,6 +166,19 @@ constexpr size_t kHistReqWire = 1 + 48;
 constexpr size_t kHistHdrWire = 1 + 12;  // nonce(u64) + count(u32)
 constexpr size_t kHistIdxEntry = 36;
 constexpr size_t kHistBatchEntry = 140;
+// Batched broadcast plane (messages.py BATCH/BATCH_ECHO/BATCH_READY/
+// BATCH_REQ):
+//   BATCH      = 0x09 | origin(32) batch_seq(u64) count(u32) sig(64)
+//                       count*(140-byte GOSSIP body)
+//   BATCH_ECHO = 0x0a | origin(32) b_origin(32) b_seq(u64) b_hash(32)
+//                       bm_len(u32) bitmap(bm_len) sig(64)
+//   BATCH_READY= 0x0b | (same body as BATCH_ECHO)
+//   BATCH_REQ  = 0x0c | b_origin(32) b_seq(u64) b_hash(32)
+constexpr size_t kBatchHdrWire = 1 + 108;  // header before entries
+constexpr size_t kBatchAttWire = 1 + 108 + 64;  // + bitmap between hdr/sig
+constexpr size_t kBatchReqWire = 1 + 72;
+constexpr uint64_t kMaxBatchEntries = 1024;  // messages.MAX_BATCH_ENTRIES
+constexpr uint64_t kMaxBitmapBytes = kMaxBatchEntries / 8;
 constexpr size_t kMinWire = kHistIdxReqWire;  // smallest message on the wire
 // A legitimate frame coalesces at most MAX_BATCH_MSGS = 1024 messages
 // (net/peers.py); 4x that is the malformed-frame bound. Without it a
@@ -225,13 +239,27 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
         uint64_t count = le32(p + 9);
         size_t entry = (kind == kHistIdx) ? kHistIdxEntry : kHistBatchEntry;
         wire = kHistHdrWire + size_t(count) * entry;  // < 2^40, no overflow
+      } else if (kind == kBatch) {
+        if (left < kBatchHdrWire) { ok = false; break; }
+        uint64_t count = le32(p + 1 + 40);  // after origin(32) + seq(8)
+        if (count < 1 || count > kMaxBatchEntries) { ok = false; break; }
+        wire = kBatchHdrWire + size_t(count) * kHistBatchEntry;
+      } else if (kind == kBatchEcho || kind == kBatchReady) {
+        if (left < kBatchAttWire) { ok = false; break; }
+        uint64_t bm_len = le32(p + 1 + 104);  // last header field
+        if (bm_len > kMaxBitmapBytes) { ok = false; break; }
+        wire = kBatchAttWire + size_t(bm_len);
+      } else if (kind == kBatchReq) {
+        wire = kBatchReqWire;
       } else { ok = false; break; }
       if (left < wire) { ok = false; break; }
       if (n_out - start >= kMaxMsgsPerFrame) { ok = false; break; }
       if (n_out >= cap) return -1;
       uint8_t* row = rows + n_out * kRowStride;
       row[0] = kind;
-      if (kind == kHistIdx || kind == kHistBatch) {
+      if (kind == kHistIdx || kind == kHistBatch || kind == kBatch ||
+          kind == kBatchEcho || kind == kBatchReady) {
+        // variable-length kinds: row carries (offset, length) into `flat`
         put_le64(row + 1, uint64_t(p + 1 - flat));
         put_le64(row + 9, uint64_t(wire - 1));
       } else {

@@ -25,22 +25,30 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..broadcast.messages import (
+    BATCH,
+    BATCH_ECHO,
+    BATCH_READY,
+    BATCH_REQ,
     ECHO,
     GOSSIP,
     HIST_BATCH,
     HIST_IDX,
     HIST_IDX_REQ,
     HIST_REQ,
+    MAX_MSGS_PER_FRAME,
     READY,
     REQUEST,
     _HIST_HDR,
     Attestation,
+    BatchAttestation,
+    BatchContentRequest,
     ContentRequest,
     HistoryBatch,
     HistoryIndex,
     HistoryIndexRequest,
     HistoryRequest,
     Payload,
+    TxBatch,
 )
 from ._build import U8P, U32P, U64P, load_lib, pack_ragged, ptr8
 
@@ -137,10 +145,11 @@ def parse_frames_native(frames: Sequence[bytes]):
     # the wire smaller than a ContentRequest, 69 bytes); if a frame turns
     # out to be dense with tiny catchup control messages (min_wire bytes
     # each) the parser returns -1 and we retry once with the true bound —
-    # which the per-frame message cap (kMaxMsgsPerFrame; frames beyond it
-    # are malformed and drop whole) keeps proportional to the frame
-    # count, not the byte count.
-    per_frame_bound = len(frames) * 4096
+    # which the per-frame message cap (MAX_MSGS_PER_FRAME, pinned against
+    # kMaxMsgsPerFrame by test_native_ingest; frames beyond it are
+    # malformed and drop whole) keeps proportional to the frame count,
+    # not the byte count.
+    per_frame_bound = len(frames) * MAX_MSGS_PER_FRAME
     for min_wire in (69, int(lib.at2_ingest_min_wire())):
         cap = min(int(flat.size // min_wire), per_frame_bound) + len(frames) + 1
         rows = np.zeros((cap, stride), dtype=np.uint8)
@@ -186,16 +195,23 @@ def parse_frames_native(frames: Sequence[bytes]):
             msg = HistoryIndexRequest.decode_body(row_bytes[base + 1 : base + 9])
         elif kind == HIST_REQ:
             msg = HistoryRequest.decode_body(row_bytes[base + 1 : base + 49])
-        elif kind in (HIST_IDX, HIST_BATCH):
+        elif kind == BATCH_REQ:
+            msg = BatchContentRequest.decode_body(row_bytes[base + 1 : base + 73])
+        elif kind in (HIST_IDX, HIST_BATCH, BATCH, BATCH_ECHO, BATCH_READY):
             # variable-length rows carry (offset, length) into `flat`
             off = int.from_bytes(row_bytes[base + 1 : base + 9], "little")
             ln = int.from_bytes(row_bytes[base + 9 : base + 17], "little")
             body = flat[off : off + ln].tobytes()
-            nonce, _count = _HIST_HDR.unpack_from(body)
-            if kind == HIST_IDX:
-                msg = HistoryIndex.decode_body(nonce, body[_HIST_HDR.size :])
+            if kind == BATCH:
+                msg = TxBatch.decode_body(body)
+            elif kind in (BATCH_ECHO, BATCH_READY):
+                msg = BatchAttestation.decode_body(kind, body)
             else:
-                msg = HistoryBatch.decode_body(nonce, body[_HIST_HDR.size :])
+                nonce, _count = _HIST_HDR.unpack_from(body)
+                if kind == HIST_IDX:
+                    msg = HistoryIndex.decode_body(nonce, body[_HIST_HDR.size :])
+                else:
+                    msg = HistoryBatch.decode_body(nonce, body[_HIST_HDR.size :])
         else:  # pragma: no cover - the C side never emits other kinds
             continue
         out.append((frame_idx[i], msg))

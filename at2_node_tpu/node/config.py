@@ -26,7 +26,11 @@ Two conscious additions over the reference schema:
   restart after crash" roadmap item (`/root/reference/README.md:52`);
 * an optional `[catchup]` table — `enabled`, `quorum`, `after`, `window`,
   `history_cap` (see `CatchupConfig`) — implements the reference's open
-  "catchup mechanism" roadmap item (`/root/reference/README.md:53`).
+  "catchup mechanism" roadmap item (`/root/reference/README.md:53`);
+* an optional `[batching]` table — `enabled`, `max_entries`, `window`
+  (see `BatchingConfig`) — ingress transaction batching over the batched
+  broadcast plane (broadcast/stack.py); `enabled = false` restores the
+  reference's one-transaction-per-broadcast-slot behavior exactly.
 """
 
 from __future__ import annotations
@@ -89,6 +93,29 @@ class CatchupConfig:
 
 
 @dataclass
+class BatchingConfig:
+    """Ingress transaction batching (broadcast/stack.py module docstring:
+    the batched broadcast plane). ``max_entries`` caps one batch slot
+    (wire hard cap 1024); ``window`` is the flush timer — the latency a
+    lone transaction pays for batching. ``enabled = false`` restores the
+    reference's one-payload-per-slot surface
+    (`/root/reference/src/bin/server/rpc.rs:275-284`) exactly; relayed
+    batches from peers are always understood either way."""
+
+    enabled: bool = True
+    max_entries: int = 256
+    window: float = 0.005
+
+    def __post_init__(self) -> None:
+        from ..broadcast.messages import MAX_BATCH_ENTRIES
+
+        if not 1 <= self.max_entries <= MAX_BATCH_ENTRIES:
+            raise ValueError(
+                f"batching.max_entries must be in [1, {MAX_BATCH_ENTRIES}]"
+            )
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -101,6 +128,7 @@ class Config:
     )
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     catchup: CatchupConfig = field(default_factory=CatchupConfig)
+    batching: BatchingConfig = field(default_factory=BatchingConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -153,6 +181,15 @@ class Config:
                 f"window = {cu.window}",
                 f"history_cap = {cu.history_cap}",
             ]
+        ba = self.batching
+        if ba != BatchingConfig():
+            lines += [
+                "",
+                "[batching]",
+                f"enabled = {'true' if ba.enabled else 'false'}",
+                f"max_entries = {ba.max_entries}",
+                f"window = {ba.window}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -170,6 +207,7 @@ class Config:
         observability = ObservabilityConfig(**doc.get("observability", {}))
         ckpt = CheckpointConfig(**doc.get("checkpoint", {}))
         catchup = CatchupConfig(**doc.get("catchup", {}))
+        batching = BatchingConfig(**doc.get("batching", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -187,6 +225,7 @@ class Config:
             observability=observability,
             checkpoint=ckpt,
             catchup=catchup,
+            batching=batching,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

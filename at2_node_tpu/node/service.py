@@ -39,6 +39,7 @@ from ..broadcast.messages import (
     HistoryIndexRequest,
     HistoryRequest,
     Payload,
+    TxBatch,
 )
 from ..broadcast.stack import Broadcast
 from ..crypto.verifier import Verifier
@@ -157,6 +158,15 @@ class Service(At2Servicer):
         # per-(peer, kind) serving budgets: [window_start, used]
         self._serve_budget: Dict[tuple, list] = {}
         self._idx_serve_offset = 0  # rotating HistoryIndex window
+        # ingress batcher (broadcast/stack.py batched plane): SendAsset
+        # payloads accumulate here and flush as ONE TxBatch slot on size
+        # or window. batch_seq is time-seeded so a restarted node never
+        # reuses a (node, batch_seq) slot peers may still remember (batch
+        # slots need uniqueness, not continuity — the ledger's per-client
+        # sequence gate is what orders transfers).
+        self._batch_buf: List[Payload] = []
+        self._batch_flush_task: Optional[asyncio.Task] = None
+        self._batch_seq = int(time.time() * 1000) << 20
 
     # -- lifecycle --------------------------------------------------------
 
@@ -284,6 +294,15 @@ class Service(At2Servicer):
 
     async def close(self) -> None:
         self._closing = True
+        if self._batch_flush_task is not None:
+            # ACK is not a commit receipt (rpc.rs:286): an unflushed
+            # ingress buffer may drop on shutdown, like any pre-broadcast
+            # payload in the reference
+            self._batch_flush_task.cancel()
+            try:
+                await self._batch_flush_task
+            except asyncio.CancelledError:
+                pass
         if self._catchup_task is not None:
             self._catchup_task.cancel()
             try:
@@ -769,6 +788,37 @@ class Service(At2Servicer):
         finally:
             self._catchup_session = None
 
+    # -- ingress batching (broadcast/stack.py batched plane) --------------
+
+    async def _flush_batch(self) -> None:
+        """Flush the accumulated SendAsset payloads as ONE batch slot.
+        Synchronous swap at entry makes concurrent flushes (size trigger
+        racing the window timer) idempotent: the loser sees an empty
+        buffer."""
+        buf = self._batch_buf
+        if not buf:
+            return
+        self._batch_buf = []
+        self._batch_seq += 1
+        entries_raw = b"".join(p.encode()[1:] for p in buf)
+        batch = TxBatch.create(
+            self.config.sign_key, self._batch_seq, entries_raw
+        )
+        await self.broadcast.broadcast_batch(batch)
+
+    async def _delayed_flush(self, window: float) -> None:
+        # Loop until the buffer is observed empty: a payload that arrived
+        # while the flush below was suspended (inbox backpressure) saw
+        # this task not-done and did NOT schedule a new timer — it relies
+        # on this loop picking it up. The empty-check and the task
+        # completing are atomic (no await between them, single event
+        # loop), so nothing can slip in after the last check.
+        while True:
+            await asyncio.sleep(window)
+            await self._flush_batch()
+            if not self._batch_buf:
+                return
+
     # -- gRPC handlers (rpc.rs:256-344) ----------------------------------
 
     async def SendAsset(self, request, context):
@@ -787,7 +837,17 @@ class Service(At2Servicer):
         await self.recent.put(request.sender, request.sequence, thin)
         payload = Payload(request.sender, request.sequence, thin, request.signature)
         # fire-and-forget: the ACK is not a commit receipt (rpc.rs:286)
-        await self.broadcast.broadcast(payload)
+        bcfg = self.config.batching
+        if not bcfg.enabled:
+            await self.broadcast.broadcast(payload)
+            return pb.SendAssetReply()
+        self._batch_buf.append(payload)
+        if len(self._batch_buf) >= bcfg.max_entries:
+            await self._flush_batch()
+        elif self._batch_flush_task is None or self._batch_flush_task.done():
+            self._batch_flush_task = asyncio.create_task(
+                self._delayed_flush(bcfg.window)
+            )
         return pb.SendAssetReply()
 
     async def GetBalance(self, request, context):

@@ -11,9 +11,14 @@ This is the reproducible source of BENCH_E2E.json's
 round-3 progression's earlier points were measured under cProfile and
 read lower).
 
+``--batch N`` groups the pre-signed firehose into TxBatch slots of N
+entries (the batched broadcast plane, broadcast/stack.py) — the lever
+VERDICT r4 asked to measure at {1, 16, 64}; ``--batch 0`` (default)
+drives the per-tx plane.
+
 Usage:
     python -m at2_node_tpu.tools.plane_bench [--nodes 3] [--txs 300]
-        [--verifier cpu] [--out -]
+        [--verifier cpu] [--batch 0] [--out -]
 """
 
 from __future__ import annotations
@@ -24,7 +29,7 @@ import json
 import sys
 import time
 
-from ..broadcast.messages import Payload
+from ..broadcast.messages import Payload, TxBatch
 from ..crypto.keys import SignKeyPair
 from ..node.config import VerifierConfig
 from ..node.service import Service
@@ -34,14 +39,50 @@ from ._common import make_net_configs, port_counter
 _ports = port_counter(27200)
 
 
-async def run(nodes: int, txs: int, verifier: str, timeout: float) -> dict:
+class _TrustAllVerifier:
+    """BENCH-ONLY plane isolation (``--verifier plane-only``): every
+    signature reports valid with zero work, modeling a verifier whose
+    throughput is not the constraint (what the broadcast plane sees in
+    front of the chip's 250k verifies/s). NOT a node config option —
+    injected only by this tool, so the unsafe mode cannot be deployed."""
+
+    async def verify(self, public_key, message, signature) -> bool:
+        return True
+
+    async def verify_many(self, items):
+        # yield once per dispatch like every real verifier does (executor
+        # hop / device dispatch): without it the broadcast workers never
+        # release the event loop mid-burst and transport tasks starve —
+        # a pathology no deployable verifier exhibits
+        await asyncio.sleep(0)
+        return [True] * len(items)
+
+    async def warmup(self) -> None:
+        pass
+
+    async def close(self) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {}
+
+
+async def run(
+    nodes: int, txs: int, verifier: str, timeout: float, batch: int = 0
+) -> dict:
+    plane_only = verifier == "plane-only"
     cfgs = make_net_configs(
-        nodes, _ports, verifier=VerifierConfig(kind=verifier)
+        nodes,
+        _ports,
+        verifier=VerifierConfig(kind="cpu" if plane_only else verifier),
     )
+    injected = _TrustAllVerifier() if plane_only else None
     services = []
     try:
         for c in cfgs:  # start INSIDE the try: a mid-start failure must
-            services.append(await Service.start(c))  # close earlier nodes
+            services.append(  # close earlier nodes
+                await Service.start(c, verifier=injected)
+            )
         sender = SignKeyPair.from_hex("77" * 32)
         recipient = SignKeyPair.from_hex("78" * 32).public
         payloads = []
@@ -50,10 +91,20 @@ async def run(nodes: int, txs: int, verifier: str, timeout: float) -> dict:
             payloads.append(
                 Payload(sender.public, seq, tx, sender.sign(tx.signing_bytes()))
             )
+        batches = []
+        if batch >= 1:  # batch=1 measures the batched plane's fixed cost
+            node_key = cfgs[0].sign_key
+            for i in range(0, len(payloads), batch):
+                raw = b"".join(p.encode()[1:] for p in payloads[i : i + batch])
+                batches.append(TxBatch.create(node_key, i + 1, raw))
 
         t0 = time.perf_counter()
-        for p in payloads:
-            await services[0].broadcast.broadcast(p)
+        if batch >= 1:
+            for b in batches:
+                await services[0].broadcast.broadcast_batch(b)
+        else:
+            for p in payloads:
+                await services[0].broadcast.broadcast(p)
         timed_out = False
         while any(s.committed < txs for s in services):
             await asyncio.sleep(0.02)
@@ -67,6 +118,7 @@ async def run(nodes: int, txs: int, verifier: str, timeout: float) -> dict:
             "config": "in-process firehose (plane microbenchmark)",
             "nodes": nodes,
             "verifier": verifier,
+            "batch": batch,
             "submitted": txs,
             "committed_per_node": committed,
             "seconds": round(dt, 3),
@@ -89,11 +141,18 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--txs", type=int, default=300)
-    ap.add_argument("--verifier", default="cpu", choices=("cpu", "tpu", "pool"))
+    ap.add_argument(
+        "--verifier",
+        default="cpu",
+        choices=("cpu", "tpu", "pool", "plane-only"),
+    )
     ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--out", default="-")
     args = ap.parse_args(argv)
-    result = asyncio.run(run(args.nodes, args.txs, args.verifier, args.timeout))
+    result = asyncio.run(
+        run(args.nodes, args.txs, args.verifier, args.timeout, args.batch)
+    )
     blob = json.dumps(result, indent=1)
     if args.out == "-":
         print(blob)

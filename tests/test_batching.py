@@ -1,0 +1,477 @@
+"""Batched-broadcast-plane tests (broadcast/stack.py module docstring).
+
+One broadcast slot carries many client transactions; these tests pin the
+properties the design argues for:
+
+* per-entry quorum counting — an entry delivers exactly when enough
+  distinct nodes endorsed IT (bitmaps, not whole batches);
+* the cross-plane entry registry — a byzantine client racing conflicting
+  same-(sender, sequence) transfers into two different honest nodes'
+  batches (or one batch + the per-tx plane) can never get both contents
+  echo-endorsed by one honest node, so with intersecting quorums at most
+  one content commits network-wide;
+* one conflicting/invalid entry never poisons its batch siblings;
+* batch content pull (totality when the batch gossip is lost);
+* the ingress batcher's size/window flush and the single-tx parity path
+  (`batching.enabled = false` restores the reference surface,
+  `/root/reference/src/bin/server/rpc.rs:275-284`).
+"""
+
+import asyncio
+import itertools
+
+import pytest
+
+from at2_node_tpu.broadcast.messages import (
+    BATCH_ECHO,
+    BATCH_READY,
+    BatchAttestation,
+    BatchContentRequest,
+    MAX_BATCH_ENTRIES,
+    Payload,
+    TxBatch,
+    WireError,
+    parse_frame,
+)
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.node.config import BatchingConfig
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.types import ThinTransaction
+
+from conftest import make_net_configs, wait_until
+
+_ports = itertools.count(23400)
+
+FAUCET = 100_000
+
+
+def make_payload(keypair, seq=1, amount=10, recipient=b"r" * 32):
+    thin = ThinTransaction(recipient, amount)
+    return Payload(keypair.public, seq, thin, keypair.sign(thin.signing_bytes()))
+
+
+def make_batch(origin_kp, payloads, batch_seq=1):
+    raw = b"".join(p.encode()[1:] for p in payloads)
+    return TxBatch.create(origin_kp, batch_seq, raw)
+
+
+class TestWire:
+    def test_batch_roundtrip(self):
+        node = SignKeyPair.random()
+        client = SignKeyPair.random()
+        batch = make_batch(
+            node, [make_payload(client, seq=s) for s in (1, 2, 3)], batch_seq=42
+        )
+        [decoded] = parse_frame(batch.encode())
+        assert decoded == batch
+        assert decoded.count == 3
+        assert decoded.content_hash() == batch.content_hash()
+        assert decoded.entries()[2].sequence == 3
+
+    def test_attestation_roundtrip_and_domain_separation(self):
+        kp = SignKeyPair.random()
+        bm = bytes([0b101])
+        args = (kp.public, 7, b"h" * 32, bm)
+        sig = kp.sign(BatchAttestation.signing_bytes(BATCH_ECHO, *args))
+        att = BatchAttestation(BATCH_ECHO, kp.public, *args[:3], bm, sig)
+        [decoded] = parse_frame(att.encode())
+        assert decoded == att
+        # an echo signature can never be replayed as a ready (and the
+        # bitmap is inside the signed bytes, so bits can't be forged on)
+        assert BatchAttestation.signing_bytes(
+            BATCH_ECHO, *args
+        ) != BatchAttestation.signing_bytes(BATCH_READY, *args)
+        assert BatchAttestation.signing_bytes(
+            BATCH_ECHO, kp.public, 7, b"h" * 32, bytes([0b111])
+        ) != BatchAttestation.signing_bytes(BATCH_ECHO, *args)
+
+    def test_content_request_roundtrip(self):
+        req = BatchContentRequest(b"o" * 32, 9, b"h" * 32)
+        assert parse_frame(req.encode()) == [req]
+
+    def test_oversized_batch_rejected(self):
+        node = SignKeyPair.random()
+        client = SignKeyPair.random()
+        batch = make_batch(node, [make_payload(client)])
+        # forge the count field beyond the cap
+        enc = bytearray(batch.encode())
+        enc[41:45] = (MAX_BATCH_ENTRIES + 1).to_bytes(4, "little")
+        with pytest.raises(WireError):
+            parse_frame(bytes(enc))
+
+    def test_native_parser_parity(self):
+        from at2_node_tpu.native import ingest_available
+        from at2_node_tpu.native.ingest import parse_frames_native
+
+        if not ingest_available():
+            pytest.skip("native ingest unavailable")
+        node = SignKeyPair.random()
+        client = SignKeyPair.random()
+        batch = make_batch(
+            node, [make_payload(client, seq=s) for s in (1, 2)], batch_seq=5
+        )
+        bm = bytes([0b11])
+        sig = node.sign(
+            BatchAttestation.signing_bytes(
+                BATCH_READY, node.public, 5, batch.content_hash(), bm
+            )
+        )
+        att = BatchAttestation(
+            BATCH_READY, node.public, node.public, 5, batch.content_hash(), bm, sig
+        )
+        req = BatchContentRequest(node.public, 5, batch.content_hash())
+        frame = batch.encode() + att.encode() + req.encode()
+        msgs, frame_ok = parse_frames_native([frame])
+        assert list(frame_ok) == [True]
+        assert [m for _, m in msgs] == parse_frame(frame) == [batch, att, req]
+        # malformed batch (count overflows the cap) drops the whole frame
+        bad = bytearray(batch.encode())
+        bad[41:45] = (MAX_BATCH_ENTRIES + 1).to_bytes(4, "little")
+        msgs2, frame_ok2 = parse_frames_native([bytes(bad), att.encode()])
+        assert list(frame_ok2) == [False, True]
+        assert [m for _, m in msgs2] == [att]
+
+
+def make_configs(n, **kwargs):
+    return make_net_configs(n, _ports, **kwargs)
+
+
+async def start_net(n, **kwargs):
+    cfgs = make_configs(n, **kwargs)
+    services = []
+    for c in cfgs:
+        services.append(await Service.start(c))
+    return cfgs, services
+
+
+async def close_all(services):
+    for s in services:
+        await s.close()
+
+
+async def submit(service, payload):
+    """Feed one client payload through the node's ingress batcher."""
+    await service.recent.put(payload.sender, payload.sequence, payload.transaction)
+    service._batch_buf.append(payload)
+
+
+class TestBatchDelivery:
+    @pytest.mark.asyncio
+    async def test_one_slot_commits_many_txs_on_all_nodes(self):
+        cfgs, services = await start_net(4)
+        try:
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            for seq in range(1, 51):
+                await submit(
+                    services[0], make_payload(sender, seq=seq, recipient=recipient)
+                )
+            await services[0]._flush_batch()
+
+            async def all_committed():
+                return all(s.committed >= 50 for s in services)
+
+            await wait_until(all_committed, what="batch entries commit")
+            for s in services:
+                assert await s.accounts.get_balance(recipient) == FAUCET + 500
+                assert await s.accounts.get_last_sequence(sender.public) == 50
+            # ONE slot: a handful of protocol messages, not 50 x 9
+            st = services[0].broadcast.stats
+            assert st["batch_rx"] >= 1
+            assert st["batch_entries_delivered"] == 50
+            assert st["gossip_rx"] == 0  # nothing rode the per-tx plane
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_invalid_entry_does_not_poison_siblings(self):
+        cfgs, services = await start_net(3)
+        try:
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            good1 = make_payload(sender, seq=1, recipient=recipient)
+            bad = Payload(  # garbage client signature
+                sender.public,
+                2,
+                ThinTransaction(recipient, 10),
+                b"\x01" * 64,
+            )
+            good2 = make_payload(sender, seq=3, recipient=recipient)
+            for p in (good1, bad, good2):
+                await submit(services[0], p)
+            await services[0]._flush_batch()
+
+            # seq 1 commits everywhere; seq 3 stays gap-blocked in the
+            # heap (seq 2 never delivers) — the commit FRONTIER is 1
+            async def seq1_committed():
+                seqs = [
+                    await s.accounts.get_last_sequence(sender.public)
+                    for s in services
+                ]
+                return all(q >= 1 for q in seqs)
+
+            await wait_until(seq1_committed, what="good sibling commits")
+            await asyncio.sleep(0.2)
+            for s in services:
+                assert await s.accounts.get_last_sequence(sender.public) == 1
+                # the invalid entry was never endorsed anywhere
+                assert s.broadcast.stats["invalid_sig"] >= 1
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_single_node_standalone_batch(self):
+        # degenerate net (no peers, thresholds 0) — mirrors the
+        # reference's standalone-node shape
+        # (/root/reference/tests/server-config-resolve-addrs)
+        cfgs, services = await start_net(1)
+        try:
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            for seq in (1, 2):
+                await submit(
+                    services[0], make_payload(sender, seq=seq, recipient=recipient)
+                )
+            await services[0]._flush_batch()
+
+            async def committed():
+                return services[0].committed >= 2
+
+            await wait_until(committed, what="standalone batch commit")
+            assert await services[0].accounts.get_balance(recipient) == FAUCET + 20
+        finally:
+            await close_all(services)
+
+
+class TestByzantineClientConflicts:
+    @pytest.mark.asyncio
+    async def test_conflicting_entries_in_two_nodes_batches(self):
+        """The attack the per-entry registry exists for: one byzantine
+        client races two conflicting seq-1 transfers into two different
+        honest ingress nodes. With echo_threshold = 3 (> n/2 of the 3
+        peers each node counts), the two contents' Echo quorums must
+        intersect in an honest node, which endorses only its first-bound
+        content — so at most ONE of the transfers commits, identically
+        on every node."""
+        cfgs, services = await start_net(4)
+        try:
+            byz = SignKeyPair.random()
+            alice = SignKeyPair.random().public
+            bob = SignKeyPair.random().public
+            pay_a = make_payload(byz, seq=1, amount=100, recipient=alice)
+            pay_b = make_payload(byz, seq=1, amount=100, recipient=bob)
+            await submit(services[0], pay_a)
+            await submit(services[1], pay_b)
+            await asyncio.gather(
+                services[0]._flush_batch(), services[1]._flush_batch()
+            )
+
+            async def resolved():
+                # every node must converge on the same outcome for seq 1
+                seqs = [
+                    await s.accounts.get_last_sequence(byz.public)
+                    for s in services
+                ]
+                return all(q == 1 for q in seqs) or all(q == 0 for q in seqs)
+
+            # give the net a moment; then assert NO divergence
+            await asyncio.sleep(1.0)
+            assert await resolved(), "nodes diverged on the conflicting slot"
+            bal_a = [await s.accounts.get_balance(alice) for s in services]
+            bal_b = [await s.accounts.get_balance(bob) for s in services]
+            assert len(set(bal_a)) == 1, f"alice balances diverged: {bal_a}"
+            assert len(set(bal_b)) == 1, f"bob balances diverged: {bal_b}"
+            # at most one of the conflicting transfers landed
+            assert not (
+                bal_a[0] == FAUCET + 100 and bal_b[0] == FAUCET + 100
+            ), "both conflicting transfers committed"
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_cross_plane_conflict_batch_vs_single_tx(self):
+        """Same attack across PLANES: content X rides a batch from node
+        0, conflicting content Y rides the per-tx plane via node 1. The
+        shared entry registry must keep honest nodes from endorsing
+        both."""
+        cfgs, services = await start_net(4)
+        try:
+            byz = SignKeyPair.random()
+            alice = SignKeyPair.random().public
+            bob = SignKeyPair.random().public
+            pay_x = make_payload(byz, seq=1, amount=50, recipient=alice)
+            pay_y = make_payload(byz, seq=1, amount=50, recipient=bob)
+            await submit(services[0], pay_x)
+            await asyncio.gather(
+                services[0]._flush_batch(),
+                services[1].broadcast.broadcast(pay_y),  # per-tx plane
+            )
+            await asyncio.sleep(1.0)
+            bal_a = [await s.accounts.get_balance(alice) for s in services]
+            bal_b = [await s.accounts.get_balance(bob) for s in services]
+            assert len(set(bal_a)) == 1, f"alice balances diverged: {bal_a}"
+            assert len(set(bal_b)) == 1, f"bob balances diverged: {bal_b}"
+            assert not (
+                bal_a[0] == FAUCET + 50 and bal_b[0] == FAUCET + 50
+            ), "both conflicting transfers committed"
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_byzantine_origin_batch_equivocation(self):
+        """A byzantine NODE gossips two different batches under one
+        (origin, batch_seq) slot. Node-level sieve (first content echoed
+        per slot) keeps honest nodes split across at most the two
+        contents; entries of at most one batch can quorate, and no node
+        diverges."""
+        cfgs, services = await start_net(4)
+        try:
+            byz_node_key = cfgs[0].sign_key  # node 0 plays byzantine
+            client = SignKeyPair.random()
+            alice = SignKeyPair.random().public
+            bob = SignKeyPair.random().public
+            batch_a = make_batch(
+                byz_node_key,
+                [make_payload(client, seq=1, recipient=alice)],
+                batch_seq=777,
+            )
+            batch_b = make_batch(
+                byz_node_key,
+                [make_payload(client, seq=1, recipient=bob)],
+                batch_seq=777,
+            )
+            # ship conflicting batches to different peers directly
+            services[0].mesh.send(services[0].mesh.peers[0], batch_a.encode())
+            services[0].mesh.send(services[0].mesh.peers[1], batch_b.encode())
+            services[0].mesh.send(services[0].mesh.peers[2], batch_a.encode())
+            await asyncio.sleep(1.0)
+            bal_a = [await s.accounts.get_balance(alice) for s in services[1:]]
+            bal_b = [await s.accounts.get_balance(bob) for s in services[1:]]
+            assert len(set(bal_a)) == 1, f"alice balances diverged: {bal_a}"
+            assert len(set(bal_b)) == 1, f"bob balances diverged: {bal_b}"
+            assert not (
+                bal_a[0] == FAUCET + 10 and bal_b[0] == FAUCET + 10
+            ), "both equivocated batches committed"
+        finally:
+            await close_all(services)
+
+
+class TestBatchContentPull:
+    @pytest.mark.asyncio
+    async def test_lost_batch_gossip_recovered_via_pull(self):
+        # same shape as the per-tx pull fault test, batched plane:
+        # thresholds let quorums form without the starved node
+        cfgs, services = await start_net(3, echo_threshold=1, ready_threshold=2)
+        victim = services[2]
+        dropped = 0
+        original = victim.mesh.on_frame
+
+        async def lossy(peer, frame):
+            nonlocal dropped
+            msgs = parse_frame(frame)
+            kept = []
+            for m in msgs:
+                if isinstance(m, TxBatch) and dropped < 2:
+                    dropped += 1
+                    continue
+                kept.append(m)
+            if kept:
+                await original(peer, b"".join(m.encode() for m in kept))
+
+        victim.mesh.on_frame = lossy
+        try:
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            await submit(services[0], make_payload(sender, recipient=recipient, amount=25))
+            await services[0]._flush_batch()
+
+            async def all_committed():
+                for s in services:
+                    if await s.accounts.get_last_sequence(sender.public) < 1:
+                        return False
+                return True
+
+            await wait_until(all_committed, what="starved node pulls the batch")
+            assert dropped == 2, "the fault never actually fired"
+            assert victim.broadcast.stats["content_req_tx"] >= 1
+            assert await victim.accounts.get_balance(recipient) == FAUCET + 25
+        finally:
+            await close_all(services)
+
+
+class TestIngressBatcher:
+    @pytest.mark.asyncio
+    async def test_window_flush_and_size_flush(self):
+        cfgs, services = await start_net(
+            1, batching=BatchingConfig(enabled=True, max_entries=4, window=0.02)
+        )
+        svc = services[0]
+        try:
+            from at2_node_tpu.client import Client
+
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                # below max_entries: the WINDOW must flush it
+                await client.send_asset(sender, 1, recipient, 5)
+
+                async def committed_one():
+                    return svc.committed >= 1
+
+                await wait_until(committed_one, what="window flush commits")
+                # exactly max_entries: the SIZE trigger flushes immediately
+                for seq in range(2, 6):
+                    await client.send_asset(sender, seq, recipient, 5)
+
+                async def committed_all():
+                    return svc.committed >= 5
+
+                await wait_until(committed_all, what="size flush commits")
+            assert await svc.accounts.get_balance(recipient) == FAUCET + 25
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_batching_disabled_uses_per_tx_plane(self):
+        cfgs, services = await start_net(
+            3, batching=BatchingConfig(enabled=False)
+        )
+        try:
+            from at2_node_tpu.client import Client
+
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset(sender, 1, recipient, 30)
+
+                async def all_committed():
+                    return all(s.committed >= 1 for s in services)
+
+                await wait_until(all_committed, what="per-tx plane commit")
+            st = services[1].broadcast.stats
+            assert st["gossip_rx"] >= 1  # rode the reference-parity plane
+            assert st["batch_rx"] == 0
+        finally:
+            await close_all(services)
+
+
+class TestConfig:
+    def test_toml_roundtrip(self):
+        cfg = make_configs(1)[0]
+        cfg.batching = BatchingConfig(enabled=True, max_entries=64, window=0.01)
+        text = cfg.dumps()
+        assert "[batching]" in text
+        from at2_node_tpu.node.config import Config
+
+        loaded = Config.loads(text)
+        assert loaded.batching == cfg.batching
+
+    def test_default_omitted_from_toml(self):
+        cfg = make_configs(1)[0]
+        assert "[batching]" not in cfg.dumps()
+
+    def test_max_entries_validated(self):
+        with pytest.raises(ValueError):
+            BatchingConfig(max_entries=MAX_BATCH_ENTRIES + 1)
+        with pytest.raises(ValueError):
+            BatchingConfig(max_entries=0)

@@ -182,7 +182,16 @@ class TestPartitionHealContentPull:
         # gossip stalls the slot net-wide — the exact fragility the
         # pull-based catch-up exists to break out of. The victim still
         # needs a full Ready quorum (2) before it pulls.
-        cfgs = make_configs(3, echo_threshold=1, ready_threshold=2)
+        # Batching OFF: this test faults the PER-TX gossip/pull plane
+        # (the batched plane's pull twin is tests/test_batching.py).
+        from at2_node_tpu.node.config import BatchingConfig
+
+        cfgs = make_configs(
+            3,
+            echo_threshold=1,
+            ready_threshold=2,
+            batching=BatchingConfig(enabled=False),
+        )
         services = [await Service.start(c) for c in cfgs]
         victim = services[2]
 
