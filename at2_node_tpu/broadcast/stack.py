@@ -272,6 +272,8 @@ class _BatchState:
         "ready_by_origin",
         "echo_votes",
         "ready_votes",
+        "own_echo_bits",
+        "ready_hash",
         "ready_sent_bits",
         "delivered_bits",
         "delivered_all",
@@ -289,7 +291,14 @@ class _BatchState:
         self.ready_by_origin: Dict[bytes, bytes] = {}
         self.echo_votes: Dict[bytes, _BatchVotes] = {}  # batch hash -> votes
         self.ready_votes: Dict[bytes, _BatchVotes] = {}
-        self.ready_sent_bits: Dict[bytes, int] = {}  # hash -> our sent bits
+        # the entries WE echo-endorsed per content (sig valid + registry
+        # agreed) — the delivery gate when thresholds degenerate to 0,
+        # where no peer quorum exists to carry the verification argument
+        self.own_echo_bits: Dict[bytes, int] = {}
+        # slot-level Ready binding, mirroring per-tx _SlotState.ready_sent:
+        # this node signs Ready for at most ONE content per batch slot
+        self.ready_hash: Optional[bytes] = None
+        self.ready_sent_bits = 0  # our cumulative Ready bits (ready_hash)
         self.delivered_bits: Dict[bytes, int] = {}  # hash -> delivered bits
         self.delivered_all = False  # some content fully delivered
         self.nbits = 0  # widest entry count seen (content or bitmap bound)
@@ -967,6 +976,7 @@ class Broadcast:
                 elif bound != entry:
                     continue  # conflicting content already endorsed
                 bits |= 1 << i
+            state.own_echo_bits[chash] = bits
             if bits:
                 self._send_batch_attestation(
                     BATCH_ECHO, slot, chash, bits, batch.count
@@ -1019,32 +1029,51 @@ class Broadcast:
         full = (1 << nbits) - 1
         ev = state.echo_votes.get(chash)
         rv = state.ready_votes.get(chash)
-        echo_q = _quorate_mask(
-            ev.counts if ev is not None else _EMPTY_COUNTS,
-            self.echo_threshold,
-            nbits,
-        )
-        ready_q = _quorate_mask(
-            rv.counts if rv is not None else _EMPTY_COUNTS,
-            self.ready_threshold,
-            nbits,
-        )
+        # Degenerate thresholds (standalone node / explicit 0): no peer
+        # quorum exists to carry the verification argument, so the gate
+        # is this node's OWN endorsement bits — a full mask here would
+        # deliver entries whose client signature FAILED (the per-tx
+        # plane drops those at the verify stage; parity demands we do
+        # too).
+        if self.echo_threshold <= 0:
+            echo_q = state.own_echo_bits.get(chash, 0)
+        else:
+            echo_q = _quorate_mask(
+                ev.counts if ev is not None else _EMPTY_COUNTS,
+                self.echo_threshold,
+                nbits,
+            )
+        if self.ready_threshold <= 0:
+            ready_q = echo_q
+        else:
+            ready_q = _quorate_mask(
+                rv.counts if rv is not None else _EMPTY_COUNTS,
+                self.ready_threshold,
+                nbits,
+            )
         # Ready an entry on its Echo quorum (sieve-deliver) OR on a full
         # Ready quorum (contagion amplification) — cumulative bitmap so a
-        # late joiner always receives a superset of earlier attestations
-        sent = state.ready_sent_bits.get(chash, 0)
-        to_ready = (echo_q | ready_q) & ~sent & full
-        if to_ready:
-            sent |= to_ready
-            state.ready_sent_bits[chash] = sent
-            self._send_batch_attestation(
-                BATCH_READY, slot, chash, sent, nbits
-            )
-        # deliver: entry-level Ready quorum, our own Ready cast, content
-        # known, not yet delivered
-        deliverable = (
-            ready_q & sent & ~state.delivered_bits.get(chash, 0) & full
-        )
+        # late joiner always receives a superset of earlier attestations.
+        # Slot-level binding (per-tx parity, _SlotState.ready_sent): this
+        # node signs Ready for at most ONE content per slot — an honest
+        # node must never be wire-indistinguishable from an equivocator.
+        wants_ready = (echo_q | ready_q) & full
+        if state.ready_hash is None and wants_ready:
+            state.ready_hash = chash
+        if state.ready_hash == chash:
+            to_ready = wants_ready & ~state.ready_sent_bits
+            if to_ready:
+                state.ready_sent_bits |= to_ready
+                self._send_batch_attestation(
+                    BATCH_READY, slot, chash, state.ready_sent_bits, nbits
+                )
+        # deliver: entry-level Ready quorum, this node has cast its Ready
+        # for the slot (per-tx parity: `... and state.ready_sent` — the
+        # quorum needn't be for OUR content, amplification covers that),
+        # content known, not yet delivered
+        if state.ready_hash is None:
+            return
+        deliverable = ready_q & ~state.delivered_bits.get(chash, 0) & full
         if not deliverable:
             return
         if batch is None:

@@ -59,9 +59,25 @@ def _lib_with_reader():
     return lib
 
 
+def reader_default_on() -> bool:
+    """Host-shape heuristic: reader threads need a core to land on. The
+    round-4 A/B measured a PENALTY on a 1-core host in the multi-process
+    shape (160.4 native vs 183.5 asyncio median, BENCH_E2E.json
+    round4_note): with nowhere to run, the C++ threads only add
+    cross-process context switching. Multi-core hosts (the deployment
+    target — the reference sizes its plane to `num_cpus`,
+    /root/reference/src/bin/server/rpc.rs:125) keep the reader ON."""
+    count = os.cpu_count()
+    return count is not None and count > 1
+
+
 def reader_available() -> bool:
     if os.environ.get("AT2_NO_NATIVE_READER"):
         return False  # kill-switch (A/B benchmarking / incident triage)
+    if not reader_default_on() and not os.environ.get(
+        "AT2_FORCE_NATIVE_READER"
+    ):
+        return False  # 1-core host: asyncio plane measured faster
     return _lib_with_reader() is not None
 
 

@@ -131,10 +131,30 @@ class Mesh:
             # native inbound plane: the listen socket is accepted manually
             # so the connection's fd can be handed to a C++ reader thread
             # wholesale after the handshake (asyncio never owns its
-            # stream buffers)
-            s = socket_mod.socket()
+            # stream buffers). An EXPLICIT host resolves via getaddrinfo
+            # like asyncio.start_server would (hostname/IPv6 listen_addrs
+            # behave the same on both planes; first result wins — the
+            # single-socket bind vs start_server's multi-bind is the one
+            # documented divergence). An empty host keeps the historical
+            # IPv4-any wildcard: getaddrinfo's wildcard ordering is
+            # platform-dependent and an AF_INET6-first result with
+            # bindv6only set would silently stop accepting IPv4 peers.
+            if host:
+                infos = await self._loop.getaddrinfo(
+                    host,
+                    int(port),
+                    type=socket_mod.SOCK_STREAM,
+                    flags=socket_mod.AI_PASSIVE,
+                )
+                family, stype, proto, _, sockaddr = infos[0]
+            else:
+                family, stype, proto = (
+                    socket_mod.AF_INET, socket_mod.SOCK_STREAM, 0
+                )
+                sockaddr = ("0.0.0.0", int(port))
+            s = socket_mod.socket(family, stype, proto)
             s.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
-            s.bind((host or "0.0.0.0", int(port)))
+            s.bind(sockaddr)
             s.listen(128)
             s.setblocking(False)
             self._listen_sock = s
@@ -323,19 +343,23 @@ class Mesh:
         os.set_blocking(rfd, False)
         os.set_blocking(wfd, False)
         rdr = NativeChannelReader(sock.fileno(), recv_key, wfd)
-        self._native_by_fd[rfd] = [peer, rdr, sock, wfd, 0]
+        # entry: [peer, reader, sock, wake_write_fd, drops, last_delivery]
+        self._native_by_fd[rfd] = [peer, rdr, sock, wfd, 0, None]
         self._loop.add_reader(rfd, self._native_wake, rfd)
 
     def _native_wake(self, rfd: int) -> None:
         """One wakeup per frame BATCH: drain the pipe, take every queued
-        frame, deliver them through the normal on_frame path in a single
-        task (on_frame never awaits internally, so ordering holds)."""
+        frame, deliver them through the normal on_frame path. Each
+        delivery task CHAINS on the connection's previous one, so
+        per-connection frame ordering holds even if on_frame ever gains
+        an internal await (it currently doesn't — but ordering must not
+        depend on that non-local property)."""
         from ..native.reader import STATUS_OPEN
 
         entry = self._native_by_fd.get(rfd)
         if entry is None:
             return
-        peer, rdr, _sock, _wfd, _ = entry
+        peer, rdr, _sock, _wfd, _, prev = entry
         try:
             os.read(rfd, 65536)
         except (BlockingIOError, OSError):
@@ -348,15 +372,25 @@ class Mesh:
                 break
         entry[4] = drops
         if frames:
-            task = asyncio.ensure_future(self._deliver_frames(peer, frames))
+            task = asyncio.ensure_future(
+                self._deliver_frames(peer, frames, prev)
+            )
             task.add_done_callback(self._log_deliver_error)
+            entry[5] = task
         if status != STATUS_OPEN:
             # eof or protocol/decrypt failure: channel-fatal, normal drop
             # (the initiating side redials; same semantics as
             # transport.ChannelClosed on the asyncio path)
             self._native_close(rfd)
 
-    async def _deliver_frames(self, peer: Peer, frames: list) -> None:
+    async def _deliver_frames(
+        self, peer: Peer, frames: list, prev: Optional[asyncio.Future] = None
+    ) -> None:
+        if prev is not None and not prev.done():
+            try:
+                await prev  # serialize behind the connection's last batch
+            except Exception:
+                pass  # already logged by its own done-callback
         for frame in frames:
             await self.on_frame(peer, frame)
 
@@ -371,7 +405,7 @@ class Mesh:
         entry = self._native_by_fd.pop(rfd, None)
         if entry is None:
             return
-        _peer, rdr, sock, wfd, drops = entry
+        _peer, rdr, sock, wfd, drops, _prev = entry
         self._reader_drops_closed += drops
         self._loop.remove_reader(rfd)
         rdr.stop()

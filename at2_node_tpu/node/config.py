@@ -83,7 +83,17 @@ class CatchupConfig:
     set >= f+1 for byzantine tolerance). ``after`` = seconds a sequence
     gap must persist in the retry heap before a catchup session starts.
     ``window`` = seconds a session waits for index/batch responses.
-    ``history_cap`` = committed payloads retained for serving peers."""
+    ``history_cap`` = committed payloads retained for serving peers —
+    the catchup HORIZON: a node absent for more commits than every
+    peer's history_cap cannot re-converge via catchup alone (sessions
+    back off exponentially rather than churn). The supported operator
+    path is a LOCAL checkpoint ([checkpoint] table) whose frontier is
+    within the horizon: restore-from-own-checkpoint + catchup-of-the-
+    tail is tested end-to-end (tests/test_faults.py
+    TestBeyondHorizonRejoin). Peer checkpoints cannot be transplanted
+    safely (ledger/history.py docstring: balances are functions of full
+    history in a consensus-free ledger), so size history_cap to cover
+    the longest absence your checkpoint cadence allows."""
 
     enabled: bool = True
     quorum: int = 0

@@ -141,6 +141,11 @@ class Service(At2Servicer):
         # the delivery loop, catchup task, and close() all drain the heap;
         # serialize the fixpoint passes so two drains never interleave
         self._drain_lock = asyncio.Lock()
+        # heap keys that entered via catchup: their TTL expiry must NOT
+        # write FAILURE into the recent ring — the slot was committed
+        # network-wide (quorum-confirmed), so a local gap-block is an
+        # "unresolved" condition, not a failed transfer (ADVICE r4)
+        self._catchup_keys: set = set()
         self._closing = False
         # ledger-history catchup (the reference's open roadmap item,
         # README.md:53): serving store + at most one in-flight session
@@ -420,19 +425,29 @@ class Service(At2Servicer):
 
     # -- delivery → commit loop ------------------------------------------
 
-    def _push_pending(self, p: Payload, now: float) -> None:
+    def _push_pending(
+        self, p: Payload, now: float, from_catchup: bool = False
+    ) -> bool:
         """Push one delivered payload onto the retry heap — the ONE place
         the heap key is built (delivery loop, catchup, and shutdown drain
         share it: the commit order must not depend on which path
         enqueued). Exact duplicates already pending are skipped: catchup
         can race normal delivery of the same slot, and the loser of the
-        sequence gate would otherwise park in the heap forever."""
+        sequence gate would otherwise park in the heap forever. Returns
+        True only when the payload was NEWLY enqueued (catchup uses this
+        to count real progress, not dedup hits)."""
         key = (p.sequence, p.sender, p.transaction.recipient, p.transaction.amount)
+        if from_catchup:
+            # quorum-confirmed regardless of which path enqueued it first
+            # (an ingress duplicate may already sit in the heap): the TTL
+            # branch must never FAILURE-mark a network-committed slot
+            self._catchup_keys.add(key)
         if key in self._heap_keys:
-            return
+            return False
         self._heap_keys.add(key)
         self._push_count += 1
         heapq.heappush(self._heap, (key, now, self._push_count, p))
+        return True
 
     async def _delivery_loop(self) -> None:
         queue = self.broadcast.delivered
@@ -496,9 +511,17 @@ class Service(At2Servicer):
                             payload.sender, payload.sequence
                         )
                         continue
-                    await self.recent.update(
-                        payload.sender, payload.sequence, TransactionState.FAILURE
-                    )
+                    if key not in self._catchup_keys:
+                        # catchup-sourced payloads are quorum-confirmed
+                        # committed network-wide; a local gap-block must
+                        # not record FAILURE for a transfer every peer
+                        # reports SUCCESS (ADVICE r4) — it stays pending
+                        # until the gap resolves or the slot goes stale
+                        await self.recent.update(
+                            payload.sender,
+                            payload.sequence,
+                            TransactionState.FAILURE,
+                        )
                     # NO continue — TTL-expired payloads still process and
                     # may flip to Success (reference quirk, rpc.rs:183-205)
                 try:
@@ -520,6 +543,7 @@ class Service(At2Servicer):
             self._heap.extend(retry)
             heapq.heapify(self._heap)
             self._heap_keys = {entry[0] for entry in self._heap}
+            self._catchup_keys &= self._heap_keys  # prune committed/dropped
             progressed = len(retry) < before
             if not self._heap or not (progressed or arrivals):
                 break
@@ -691,19 +715,33 @@ class Service(At2Servicer):
     # right after a restart, peers' redial backoff (net/peers.py, capped
     # at 5s) can delay their replies past several session windows.
     _CATCHUP_MIN_ATTEMPTS = 8
+    # After this many consecutive sessions without commit progress the
+    # runner backs off exponentially (doubling per session) up to the
+    # max. A gap beyond every peer's history horizon can NEVER resolve
+    # via catchup (ledger/history.py:19-23 — operator action required);
+    # without backoff each session re-broadcasts HistoryRequests and
+    # re-verifies up to MAX_RANGE payloads per peer every cfg.after
+    # seconds forever (ADVICE r4 medium).
+    _CATCHUP_BACKOFF_AFTER = 3
+    _CATCHUP_MAX_BACKOFF = 60.0
 
     async def _catchup_runner(self, initial_delay: float = 0.0) -> None:
         """Run catchup sessions until the ledger is caught up: no stale
         sequence gap remains AND at least one peer has answered (or the
-        attempt budget for unanswered sessions is spent)."""
+        attempt budget for unanswered sessions is spent). Sessions that
+        stop producing COMMIT progress back off exponentially."""
         cfg = self.config.catchup
         if initial_delay:
             await asyncio.sleep(initial_delay)
         attempts = 0
+        no_progress = 0  # consecutive sessions with no commit progress
         try:
             while not self._closing:
+                committed_before = self.committed
                 responses, applied = await self._catchup_once()
                 attempts += 1
+                progressed = applied > 0 or self.committed > committed_before
+                no_progress = 0 if progressed else no_progress + 1
                 now = time.monotonic()
                 gap_remains = any(
                     now - entry[1] > cfg.after for entry in self._heap
@@ -720,7 +758,14 @@ class Service(At2Servicer):
                         attempts,
                         responses,
                     )
-                await asyncio.sleep(cfg.after)
+                delay = cfg.after
+                if no_progress > self._CATCHUP_BACKOFF_AFTER:
+                    delay = min(
+                        cfg.after
+                        * 2 ** (no_progress - self._CATCHUP_BACKOFF_AFTER),
+                        self._CATCHUP_MAX_BACKOFF,
+                    )
+                await asyncio.sleep(delay)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -772,8 +817,13 @@ class Service(At2Servicer):
             applied = 0
             for p, ok in zip(candidates, results):
                 if ok and p.sequence > frontier.get(p.sender, 0):
-                    self._push_pending(p, now)
-                    applied += 1
+                    # only NEWLY-enqueued payloads count as progress: a
+                    # dedup hit on a heap entry parked since the last
+                    # session is churn, not advancement (ADVICE r4 —
+                    # counting those kept `applied > 0` forever and
+                    # defeated the runner's termination condition)
+                    if self._push_pending(p, now, from_catchup=True):
+                        applied += 1
                 elif not ok:
                     logger.warning(
                         "catchup payload failed signature check: (%s, %d)",

@@ -342,7 +342,26 @@ def _emit(result: dict) -> None:
     print(json.dumps(result))
 
 
+def _current_round() -> int | None:
+    """Best-effort round number from the driver's PROGRESS.jsonl."""
+    try:
+        with open(os.path.join(_REPO, "PROGRESS.jsonl")) as f:
+            last = None
+            for line in f:
+                if line.strip():
+                    last = line
+        return json.loads(last)["round"] if last else None
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def _fallback(error: str) -> None:
+    # Provenance vs link state are SEPARATE facts (round-4 verdict #7):
+    # `captured_at`/`captured_round` say when the banked VALUE was
+    # measured on the chip; `tunnel_live_at_write: false` says only that
+    # the tunnel was dead when THIS artifact was written. A same-round
+    # capture re-emitted through this path is fresh evidence, not a
+    # relic — the old single `stale` flag conflated the two.
     last = _load_lastgood()
     if last is None:
         _emit(
@@ -351,12 +370,14 @@ def _fallback(error: str) -> None:
                 "value": 0.0,
                 "unit": "sigs/s",
                 "vs_baseline": 0.0,
+                "tunnel_live_at_write": False,
                 "error": error,
             }
         )
         return
     out = dict(last)
-    out["stale"] = True
+    out.pop("stale", None)  # superseded by the split fields
+    out["tunnel_live_at_write"] = False
     out["error"] = error
     _emit(out)
 
@@ -445,6 +466,11 @@ def orchestrate() -> None:
         banked["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )
+        banked["captured_round"] = _current_round()
+        banked["tunnel_live_at_write"] = True
+        result["captured_at"] = banked["captured_at"]
+        result["captured_round"] = banked["captured_round"]
+        result["tunnel_live_at_write"] = True
         try:
             with open(LASTGOOD_PATH, "w") as f:
                 json.dump(banked, f, indent=1)

@@ -220,6 +220,33 @@ class TestBatchDelivery:
             await close_all(services)
 
     @pytest.mark.asyncio
+    async def test_standalone_invalid_entry_never_commits(self):
+        """Degenerate thresholds (0) must NOT bypass client-signature
+        verification: with no peer quorum to carry the argument, the
+        delivery gate is the node's OWN endorsement bits — a forged
+        entry in a standalone node's batch stays out of the ledger
+        (code-review r5 finding)."""
+        cfgs, services = await start_net(1)
+        try:
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            forged = Payload(
+                sender.public, 1, ThinTransaction(recipient, 1000), b"\x03" * 64
+            )
+            good = make_payload(sender, seq=2, recipient=recipient, amount=7)
+            for p in (forged, good):
+                await submit(services[0], p)
+            await services[0]._flush_batch()
+            await asyncio.sleep(0.5)
+            # the forged transfer never committed; seq 2 is gap-blocked
+            # behind it (exactly like the per-tx plane would behave)
+            assert await services[0].accounts.get_last_sequence(sender.public) == 0
+            assert await services[0].accounts.get_balance(recipient) == FAUCET
+            assert services[0].broadcast.stats["invalid_sig"] >= 1
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
     async def test_single_node_standalone_batch(self):
         # degenerate net (no peers, thresholds 0) — mirrors the
         # reference's standalone-node shape

@@ -32,7 +32,8 @@ import time
 
 import pytest
 
-from at2_node_tpu.broadcast.messages import Payload, parse_frame
+from at2_node_tpu.broadcast.messages import Payload, TxBatch, parse_frame
+from at2_node_tpu.types import TransactionState
 from at2_node_tpu.client import Client
 from at2_node_tpu.crypto.keys import SignKeyPair
 from at2_node_tpu.node.config import CatchupConfig, CheckpointConfig
@@ -96,10 +97,15 @@ class TestKillRestartRedial:
                     what="full commit parity after restart",
                 )
             # the restarted node's broadcast saw tx3 via redialed links,
-            # recovered seq 1-2 via the catchup protocol, and its ledger
-            # fully re-converged
+            # recovered the missed history via the catchup protocol, and
+            # its ledger fully re-converged. catchup_applied counts only
+            # NEWLY-enqueued payloads (ADVICE r4): part of the gap can
+            # arrive via the survivors' queued send backlog on redial
+            # (tx2's frames were parked in their bounded send queues
+            # while the node was down), so the catchup's own share is
+            # >= 1, not necessarily the whole gap.
             assert services[2].broadcast.stats["delivered"] >= 1
-            assert services[2].catchup_stats["catchup_applied"] >= 2
+            assert services[2].catchup_stats["catchup_applied"] >= 1
             for s in services:
                 assert await s.accounts.get_balance(sender.public) == FAUCET - 30
                 assert await s.accounts.get_balance(recipient) == FAUCET + 30
@@ -239,6 +245,164 @@ class TestPartitionHealContentPull:
             )
             assert served >= 1
             assert await victim.accounts.get_balance(recipient) == FAUCET + 25
+        finally:
+            for s in services:
+                await s.close()
+
+
+class TestBeyondHorizonRejoin:
+    """VERDICT r4 #3/#4: the rejoin story when the gap EXCEEDS peers'
+    bounded history horizon (ledger/history.py retention). Two halves:
+
+    * the documented operator path WORKS: a node restoring from its own
+      stale local checkpoint only needs the tail within the horizon —
+      tested end-to-end with a tiny history_cap;
+    * without a checkpoint the gap is genuinely unrecoverable via
+      catchup (the docstring's honest limit) — and the node must
+      DEGRADE SOUNDLY: no livelock (catchup progress counted honestly,
+      sessions back off — ADVICE r4 medium), and no recent-ring FAILURE
+      record for slots the network committed (ADVICE r4 low).
+    """
+
+    @pytest.mark.asyncio
+    async def test_stale_checkpoint_plus_catchup_tail_converges(
+        self, tmp_path
+    ):
+        cfgs = make_configs(
+            3,
+            echo_threshold=1,
+            ready_threshold=1,
+            catchup=CatchupConfig(
+                quorum=2, after=0.3, window=0.3, history_cap=4
+            ),
+        )
+        # node2 snapshots on graceful shutdown (interval<=0: final only)
+        cfgs[2].checkpoint = CheckpointConfig(
+            path=str(tmp_path / "node2.ckpt"), interval=0
+        )
+        services = [await Service.start(c) for c in cfgs]
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                for seq in range(1, 7):
+                    await client.send_asset(sender, seq, recipient, 10)
+                await wait_until(
+                    lambda: _committed_on(services, 6, sender.public),
+                    what="seqs 1-6 everywhere",
+                )
+                # node2 leaves gracefully -> checkpoint at frontier 6
+                await services[2].close()
+                # the network moves on; peers' history_cap=4 retains
+                # only seqs 7-10 — seqs 1-6 fall past the horizon
+                for seq in range(7, 11):
+                    await client.send_asset(sender, seq, recipient, 10)
+                await wait_until(
+                    lambda: _committed_on(services[:2], 10, sender.public),
+                    what="seqs 7-10 on survivors",
+                )
+                # rejoin: checkpoint restores frontier 6; catchup pulls
+                # exactly the in-horizon tail 7-10 and re-converges
+                services[2] = await Service.start(cfgs[2])
+                # Simulate a LONG absence at the wire boundary: on a
+                # short outage the survivors' outbound loops replay
+                # their in-flight batch on redial (bounded queues +
+                # retained `pending`), which would hand node2 the tail
+                # for free; over a multi-day gap that replay holds only
+                # unrelated recent traffic. Drop replayed gossip at the
+                # victim's ingress so convergence must come from the
+                # CATCHUP protocol (HistoryBatch passes untouched).
+                original = services[2].mesh.on_frame
+
+                async def no_gossip_replay(peer, frame, _orig=original):
+                    kept = [
+                        m
+                        for m in parse_frame(frame)
+                        if not isinstance(m, (Payload, TxBatch))
+                    ]
+                    if kept:
+                        await _orig(
+                            peer, b"".join(m.encode() for m in kept)
+                        )
+
+                services[2].mesh.on_frame = no_gossip_replay
+                await wait_until(
+                    lambda: _committed_on(services, 10, sender.public),
+                    what="full re-convergence from stale checkpoint + tail",
+                )
+            for s in services:
+                assert await s.accounts.get_balance(recipient) == FAUCET + 100
+                assert await s.accounts.get_balance(sender.public) == FAUCET - 100
+            assert services[2].catchup_stats["catchup_applied"] >= 1
+        finally:
+            for s in services:
+                await s.close()
+
+    @pytest.mark.asyncio
+    async def test_no_checkpoint_degrades_soundly(self, monkeypatch):
+        import at2_node_tpu.node.service as service_mod
+
+        # short TTL so the gap-blocked entries expire several times
+        # within the test window (the FAILURE-suppression path)
+        monkeypatch.setattr(service_mod, "TRANSACTION_TTL", 0.3)
+        cfgs = make_configs(
+            3,
+            echo_threshold=1,
+            ready_threshold=1,
+            catchup=CatchupConfig(
+                quorum=2, after=0.3, window=0.3, history_cap=4
+            ),
+        )
+        services = [await Service.start(c) for c in cfgs]
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                for seq in range(1, 11):
+                    await client.send_asset(sender, seq, recipient, 10)
+                await wait_until(
+                    lambda: _committed_on(services, 10, sender.public),
+                    what="seqs 1-10 everywhere",
+                )
+                # node2 dies with TOTAL state loss (no checkpoint) and
+                # rejoins: it needs 1-10 but peers retain only 7-10
+                await services[2].close()
+                services[2] = await Service.start(cfgs[2])
+                victim = services[2]
+
+                async def sessions_ran():
+                    return victim.catchup_stats["catchup_sessions"] >= 2
+
+                await wait_until(sessions_ran, what="catchup sessions ran")
+                # the network-committed tail is re-submitted through the
+                # VICTIM's ingress (deterministic ed25519 -> identical
+                # content): it lands in its recent ring as Pending and
+                # gap-blocks — the exact shape where the old code wrote
+                # FAILURE for a transfer every peer calls SUCCESS
+                async with Client(f"http://{cfgs[2].rpc_address}") as c2:
+                    await c2.send_asset(sender, 8, recipient, 10)
+                await asyncio.sleep(1.2)  # > several TTLs and sessions
+                applied_then = victim.catchup_stats["catchup_applied"]
+                # honest progress counting: the in-horizon tail entered
+                # the heap ONCE; later sessions are dedup hits, not
+                # "progress" (the ADVICE livelock: applied never 0)
+                assert 1 <= applied_then <= 4
+                await asyncio.sleep(1.0)
+                assert victim.catchup_stats["catchup_applied"] == applied_then
+                # the gap is genuinely unrecoverable: frontier stays 0
+                assert (
+                    await victim.accounts.get_last_sequence(sender.public)
+                ) == 0
+                # ...and the ring NEVER contradicts the network: seq 8 is
+                # committed everywhere else; locally it must still read
+                # PENDING (gap-blocked), not FAILURE
+                ring = await victim.recent.get_all()
+                states = {
+                    t.sender_sequence: t.state
+                    for t in ring
+                    if t.sender == sender.public
+                }
+                assert states.get(8) == TransactionState.PENDING, states
         finally:
             for s in services:
                 await s.close()

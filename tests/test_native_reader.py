@@ -29,14 +29,24 @@ from at2_node_tpu.native.reader import (
     STATUS_OPEN,
     STATUS_PROTOCOL_ERROR,
     NativeChannelReader,
-    reader_available,
+    _lib_with_reader,
 )
 
 from conftest import make_net_configs, wait_until
 
+# Gate on the LIBRARY being buildable, not on reader_available(): the
+# core-count heuristic turns the reader off on 1-core CI hosts, but
+# these tests exist to exercise the native plane — each forces it on
+# via the fixture below (except the heuristic tests, which manage the
+# env themselves).
 pytestmark = pytest.mark.skipif(
-    not reader_available(), reason="native reader library unavailable"
+    _lib_with_reader() is None, reason="native reader library unavailable"
 )
+
+
+@pytest.fixture(autouse=True)
+def _force_native(monkeypatch):
+    monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
 
 _ports = itertools.count(23600)
 
@@ -168,7 +178,9 @@ async def _converge_two_nodes():
 
 
 @pytest.mark.asyncio
-async def test_mesh_uses_native_readers_and_converges():
+async def test_mesh_uses_native_readers_and_converges(monkeypatch):
+    # force past the core-count heuristic: the CI host may be 1-core
+    monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
     stats = await _converge_two_nodes()
     # both nodes accepted their inbound connection onto the native plane
     assert all(s["native_readers"] >= 1 for s in stats), stats
@@ -179,3 +191,45 @@ async def test_mesh_asyncio_fallback_converges(monkeypatch):
     monkeypatch.setenv("AT2_NO_NATIVE_READER", "1")
     stats = await _converge_two_nodes()
     assert all(s["native_readers"] == 0 for s in stats), stats
+
+
+class TestPlaneSelectionHeuristic:
+    """VERDICT r4 #5: the inbound plane self-selects by host shape —
+    native reader threads default OFF on a 1-core host (the
+    measured-penalty shape, BENCH_E2E.json round4_note) and ON
+    otherwise; env vars override in both directions."""
+
+    def test_single_core_defaults_off(self, monkeypatch):
+        from at2_node_tpu.native import reader
+
+        monkeypatch.delenv("AT2_NO_NATIVE_READER", raising=False)
+        monkeypatch.delenv("AT2_FORCE_NATIVE_READER", raising=False)
+        monkeypatch.setattr(reader.os, "cpu_count", lambda: 1)
+        assert not reader.reader_default_on()
+        assert not reader.reader_available()
+
+    def test_single_core_force_overrides(self, monkeypatch):
+        from at2_node_tpu.native import reader
+
+        monkeypatch.delenv("AT2_NO_NATIVE_READER", raising=False)
+        monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
+        monkeypatch.setattr(reader.os, "cpu_count", lambda: 1)
+        # availability now depends only on the library actually loading
+        assert reader.reader_available() == (reader._lib_with_reader() is not None)
+
+    def test_multi_core_defaults_on(self, monkeypatch):
+        from at2_node_tpu.native import reader
+
+        monkeypatch.delenv("AT2_NO_NATIVE_READER", raising=False)
+        monkeypatch.delenv("AT2_FORCE_NATIVE_READER", raising=False)
+        monkeypatch.setattr(reader.os, "cpu_count", lambda: 8)
+        assert reader.reader_default_on()
+        assert reader.reader_available() == (reader._lib_with_reader() is not None)
+
+    def test_kill_switch_beats_force(self, monkeypatch):
+        from at2_node_tpu.native import reader
+
+        monkeypatch.setenv("AT2_NO_NATIVE_READER", "1")
+        monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
+        monkeypatch.setattr(reader.os, "cpu_count", lambda: 8)
+        assert not reader.reader_available()
