@@ -386,10 +386,15 @@ class TpuBatchVerifier:
 
     def _prep(self, pks, msgs, sigs, bucket):
         """Host stage: bucket policy + batch prep + packing (the shape
-        rules — incl. Pallas TILE rounding — live in ops.ed25519)."""
+        rules — incl. Pallas TILE rounding — live in ops.ed25519), then
+        the host->device upload — HERE rather than in _launch so batch
+        N+1's tunnel transfer overlaps batch N's dispatch/kernel (the
+        round-4 trace attributes the 250k-vs-475k pipelined gap to
+        transfers serializing on the launch thread; ops/ed25519.py
+        upload_packed)."""
         from ..ops import ed25519 as kernel
 
-        return kernel.prep_packed(pks, msgs, sigs, bucket)
+        return kernel.upload_packed(kernel.prep_packed(pks, msgs, sigs, bucket))
 
     def _launch(self, packed):
         """Device stage: transfer + dispatch + start the async copy-back;

@@ -67,8 +67,13 @@ def reader_default_on() -> bool:
     cross-process context switching. Multi-core hosts (the deployment
     target — the reference sizes its plane to `num_cpus`,
     /root/reference/src/bin/server/rpc.rs:125) keep the reader ON."""
-    count = os.cpu_count()
-    return count is not None and count > 1
+    try:
+        # cores this process may actually RUN on (cgroup/affinity aware;
+        # a 1-cpu container on a 64-core host must read as 1)
+        count = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux fallback
+        count = os.cpu_count() or 0
+    return count > 1
 
 
 def reader_available() -> bool:

@@ -146,6 +146,11 @@ class Service(At2Servicer):
         # network-wide (quorum-confirmed), so a local gap-block is an
         # "unresolved" condition, not a failed transfer (ADVICE r4)
         self._catchup_keys: set = set()
+        # commits of catchup-keyed payloads: the runner's progress
+        # signal. The global `committed` counter won't do — unrelated
+        # live traffic keeps it rising and would reset the backoff
+        # forever on a beyond-horizon gap.
+        self._catchup_commits = 0
         self._closing = False
         # ledger-history catchup (the reference's open roadmap item,
         # README.md:53): serving store + at most one in-flight session
@@ -299,15 +304,6 @@ class Service(At2Servicer):
 
     async def close(self) -> None:
         self._closing = True
-        if self._batch_flush_task is not None:
-            # ACK is not a commit receipt (rpc.rs:286): an unflushed
-            # ingress buffer may drop on shutdown, like any pre-broadcast
-            # payload in the reference
-            self._batch_flush_task.cancel()
-            try:
-                await self._batch_flush_task
-            except asyncio.CancelledError:
-                pass
         if self._catchup_task is not None:
             self._catchup_task.cancel()
             try:
@@ -340,6 +336,17 @@ class Service(At2Servicer):
                 # stop() on a server whose start() never completed (failed
                 # bring-up path) can raise; the socket dies with the object
                 logger.exception("grpc server stop failed")
+        # AFTER the RPC surface is down (no SendAsset can respawn it):
+        # cancel the flush timer. ACK is not a commit receipt (rpc.rs:286)
+        # — an unflushed ingress buffer may drop on shutdown, like any
+        # pre-broadcast payload in the reference. SendAsset also gates on
+        # _closing, so a handler mid-await cannot recreate the task.
+        if self._batch_flush_task is not None:
+            self._batch_flush_task.cancel()
+            try:
+                await self._batch_flush_task
+            except asyncio.CancelledError:
+                pass
         if self._delivery_task is not None:
             self._delivery_task.cancel()
             try:
@@ -526,6 +533,8 @@ class Service(At2Servicer):
                     # may flip to Success (reference quirk, rpc.rs:183-205)
                 try:
                     await self._process_payload(payload)
+                    if key in self._catchup_keys:
+                        self._catchup_commits += 1
                 except AccountModificationError as exc:
                     logger.debug(
                         "retrying payload (%s, %d): %s",
@@ -737,10 +746,16 @@ class Service(At2Servicer):
         no_progress = 0  # consecutive sessions with no commit progress
         try:
             while not self._closing:
-                committed_before = self.committed
+                commits_before = self._catchup_commits
                 responses, applied = await self._catchup_once()
                 attempts += 1
-                progressed = applied > 0 or self.committed > committed_before
+                # progress = catchup-sourced work only: new payloads
+                # enqueued, or catchup-keyed payloads committed. The
+                # global commit counter would count unrelated live
+                # traffic and keep resetting the backoff forever.
+                progressed = (
+                    applied > 0 or self._catchup_commits > commits_before
+                )
                 no_progress = 0 if progressed else no_progress + 1
                 now = time.monotonic()
                 gap_remains = any(
@@ -888,7 +903,9 @@ class Service(At2Servicer):
         payload = Payload(request.sender, request.sequence, thin, request.signature)
         # fire-and-forget: the ACK is not a commit receipt (rpc.rs:286)
         bcfg = self.config.batching
-        if not bcfg.enabled:
+        if not bcfg.enabled or self._closing:
+            # during shutdown, skip the batcher: a flush timer spawned
+            # after close() cancelled the old one would be orphaned
             await self.broadcast.broadcast(payload)
             return pb.SendAssetReply()
         self._batch_buf.append(payload)

@@ -240,9 +240,23 @@ def prep_packed(
     )
 
 
-def launch_packed(packed: np.ndarray):
-    """Pipeline stage 2 (device): transfer + dispatch + start the async
-    copy-back; returns the in-flight handle without blocking."""
+def upload_packed(packed: np.ndarray):
+    """Host->device transfer, separable from dispatch: the round-4 chip
+    trace (.profile_traces/bench_b65536) attributes the pipelined-vs-
+    device-only gap (250.6k vs 475.5k sigs/s) to per-batch tunnel
+    transfers serializing with compute — one 64k batch is ~129 ms of
+    kernel plus ~126 ms of transfer that never overlapped. Running the
+    upload on the PREP thread (TpuBatchVerifier._prep) lets batch N+1's
+    transfer proceed while batch N occupies the launch thread."""
+    import jax
+
+    return jax.device_put(packed)
+
+
+def launch_packed(packed):
+    """Pipeline stage 2 (device): dispatch + start the async copy-back;
+    returns the in-flight handle without blocking. Accepts a host array
+    too (device_put on an already-transferred array is a no-op)."""
     import jax
 
     if _use_pallas():

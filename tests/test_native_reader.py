@@ -199,12 +199,24 @@ class TestPlaneSelectionHeuristic:
     measured-penalty shape, BENCH_E2E.json round4_note) and ON
     otherwise; env vars override in both directions."""
 
+    @staticmethod
+    def _pin_cores(monkeypatch, n: int) -> None:
+        """The heuristic reads the AFFINITY mask (cgroup/taskset aware),
+        falling back to cpu_count — pin both."""
+        from at2_node_tpu.native import reader
+
+        monkeypatch.setattr(
+            reader.os, "sched_getaffinity", lambda pid: set(range(n)),
+            raising=False,
+        )
+        monkeypatch.setattr(reader.os, "cpu_count", lambda: n)
+
     def test_single_core_defaults_off(self, monkeypatch):
         from at2_node_tpu.native import reader
 
         monkeypatch.delenv("AT2_NO_NATIVE_READER", raising=False)
         monkeypatch.delenv("AT2_FORCE_NATIVE_READER", raising=False)
-        monkeypatch.setattr(reader.os, "cpu_count", lambda: 1)
+        self._pin_cores(monkeypatch, 1)
         assert not reader.reader_default_on()
         assert not reader.reader_available()
 
@@ -213,7 +225,7 @@ class TestPlaneSelectionHeuristic:
 
         monkeypatch.delenv("AT2_NO_NATIVE_READER", raising=False)
         monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
-        monkeypatch.setattr(reader.os, "cpu_count", lambda: 1)
+        self._pin_cores(monkeypatch, 1)
         # availability now depends only on the library actually loading
         assert reader.reader_available() == (reader._lib_with_reader() is not None)
 
@@ -222,14 +234,26 @@ class TestPlaneSelectionHeuristic:
 
         monkeypatch.delenv("AT2_NO_NATIVE_READER", raising=False)
         monkeypatch.delenv("AT2_FORCE_NATIVE_READER", raising=False)
-        monkeypatch.setattr(reader.os, "cpu_count", lambda: 8)
+        self._pin_cores(monkeypatch, 8)
         assert reader.reader_default_on()
         assert reader.reader_available() == (reader._lib_with_reader() is not None)
+
+    def test_affinity_narrower_than_host_wins(self, monkeypatch):
+        # a 1-cpu container/cgroup on a multi-core host must read as 1
+        from at2_node_tpu.native import reader
+
+        monkeypatch.delenv("AT2_NO_NATIVE_READER", raising=False)
+        monkeypatch.delenv("AT2_FORCE_NATIVE_READER", raising=False)
+        monkeypatch.setattr(
+            reader.os, "sched_getaffinity", lambda pid: {0}, raising=False
+        )
+        monkeypatch.setattr(reader.os, "cpu_count", lambda: 64)
+        assert not reader.reader_default_on()
 
     def test_kill_switch_beats_force(self, monkeypatch):
         from at2_node_tpu.native import reader
 
         monkeypatch.setenv("AT2_NO_NATIVE_READER", "1")
         monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
-        monkeypatch.setattr(reader.os, "cpu_count", lambda: 8)
+        self._pin_cores(monkeypatch, 8)
         assert not reader.reader_available()
