@@ -140,10 +140,32 @@ DEDUP_CAP = 1 << 20
 MAX_LIVE_SLOTS = 1 << 17
 DELIVERED_RETENTION = 120.0  # s after delivery before the slot compacts
 SLOT_MAX_AGE = 3600.0  # s an undelivered slot may linger
-GC_INTERVAL = 30.0
+GC_INTERVAL = 5.0
 # Min seconds between content re-requests for a ready-quorate slot whose
 # payload gossip never arrived (pull-based catch-up; see module docstring).
 REQUEST_RETRY = 5.0
+# Stalled-slot retransmission (liveness under message loss): the planes
+# are best-effort (bounded queues drop under overload, burst measurements
+# showed a single lost attestation gap-blocking a whole sender at
+# thresholds = n_peers), so a slot still undelivered RETRANSMIT_AFTER
+# seconds after creation re-broadcasts this node's content + own
+# attestations, at most every RETRANSMIT_EVERY per slot. Receivers that
+# already saw them dedup at the pre-verify stage for the cost of a set
+# lookup (deterministic ed25519: a re-signed attestation is
+# byte-identical, so _attest_seen absorbs it).
+RETRANSMIT_AFTER = 5.0
+RETRANSMIT_EVERY = 10.0
+# Global per-GC-pass retransmission budget: after a mass stall (burst
+# overflow parking thousands of slots) an unbounded pass would re-inject
+# B x n_peers frames at once — re-creating the overload it heals.
+# Skipped slots keep their old retransmitted_at, so subsequent passes
+# rotate through them naturally.
+RETRANSMIT_BUDGET_PER_PASS = 64
+# An undelivered slot this old has outlived push-retransmission AND the
+# helpers' delivered-state retention may be expiring: hand recovery to
+# the ledger-catchup plane (stall_handler -> node.service._kick_catchup),
+# which replays the committed slot from peers' history stores.
+STALLED_CATCHUP_AFTER = 30.0
 # Max messages one worker drains from the inbox per iteration: the unit of
 # bulk verification (one verify_many call -> one slice of the TPU batch).
 WORKER_CHUNK = 256
@@ -266,6 +288,8 @@ class _BatchState:
     __slots__ = (
         "created",
         "content_requested_at",
+        "retransmitted_at",
+        "helped_at",
         "contents",
         "echoed_hash",
         "echo_by_origin",
@@ -283,6 +307,8 @@ class _BatchState:
     def __init__(self) -> None:
         self.created = time.monotonic()
         self.content_requested_at = 0.0
+        self.retransmitted_at = 0.0  # last stalled-slot retransmission
+        self.helped_at: Dict[bytes, float] = {}  # per-peer help pacing
         self.contents: Dict[bytes, TxBatch] = {}  # batch hash -> batch
         self.echoed_hash: Optional[bytes] = None  # first content echoed here
         # first vote per origin per phase binds that origin to ONE batch
@@ -313,15 +339,21 @@ class _SlotState:
         "echo_by_origin",
         "ready_by_origin",
         "ready_sent",
+        "ready_hash",
         "sieve_delivered",
         "delivered",
         "created",
         "content_requested_at",
+        "retransmitted_at",
+        "helped_at",
     )
 
     def __init__(self) -> None:
         self.created = time.monotonic()
         self.content_requested_at = 0.0  # last pull request, 0 = never
+        self.retransmitted_at = 0.0  # last stalled-slot retransmission
+        self.helped_at: Dict[bytes, float] = {}  # per-peer help pacing
+        self.ready_hash: Optional[bytes] = None  # content our READY covers
         self.contents: Dict[bytes, Payload] = {}  # content_hash -> payload
         self.echoed_hash: Optional[bytes] = None  # sieve: first content only
         self.echoes: Dict[bytes, Set[bytes]] = defaultdict(set)  # hash -> origins
@@ -388,6 +420,10 @@ class Broadcast:
         # node-service hook for catchup-plane messages (sync callable
         # (peer, msg) -> None); None drops them (a stack used standalone)
         self.catchup_handler = None
+        # node-service hook fired (once per GC pass) when some slot has
+        # been stalled past STALLED_CATCHUP_AFTER: push-retransmission
+        # has failed, recovery belongs to the ledger-catchup plane
+        self.stall_handler = None
         # observability counters (SURVEY.md §5: per-stage counters)
         self.stats = {
             "gossip_rx": 0,
@@ -403,6 +439,7 @@ class Broadcast:
             "batch_echo_rx": 0,
             "batch_ready_rx": 0,
             "batch_entries_delivered": 0,
+            "retransmits": 0,
         }
 
     async def start(self) -> None:
@@ -455,10 +492,14 @@ class Broadcast:
     # -- workers ----------------------------------------------------------
 
     async def _gc_loop(self) -> None:
-        """Compact delivered slots and expire dead ones (memory bound)."""
+        """Compact delivered slots, expire dead ones (memory bound), and
+        drive stalled-slot recovery (budgeted retransmission + the
+        catchup-plane stall signal)."""
         while True:
             await asyncio.sleep(GC_INTERVAL)
             now = time.monotonic()
+            budget = RETRANSMIT_BUDGET_PER_PASS
+            stalled_past_horizon = False
             for slot in list(self._slots):
                 state = self._slots[slot]
                 age = now - state.created
@@ -478,6 +519,10 @@ class Broadcast:
                             and chash not in state.contents
                         ):
                             self._request_content(slot, state, chash)
+                    if budget > 0 and self._retransmit_slot(slot, state, now):
+                        budget -= 1
+                    if age > STALLED_CATCHUP_AFTER:
+                        stalled_past_horizon = True
             for slot in list(self._batch_slots):
                 bstate = self._batch_slots[slot]
                 age = now - bstate.created
@@ -498,6 +543,134 @@ class Broadcast:
                         )
                         if quorate & ~bstate.delivered_bits.get(chash, 0):
                             self._request_batch_content(slot, bstate, chash)
+                    if budget > 0 and self._retransmit_batch_slot(
+                        slot, bstate, now
+                    ):
+                        budget -= 1
+                    if age > STALLED_CATCHUP_AFTER:
+                        stalled_past_horizon = True
+            if stalled_past_horizon and self.stall_handler is not None:
+                # beyond push-retransmission: the slot may be committed
+                # network-wide with the helpers' delivered state expiring
+                # — the ledger-catchup plane replays it from history
+                try:
+                    self.stall_handler()
+                except Exception:
+                    logger.exception("stall handler error")
+
+    def _resend_slot(
+        self, slot: Slot, state: _SlotState, peer: Optional[Peer]
+    ) -> bool:
+        """Re-emit this node's content copy + own attestations for a
+        slot — broadcast (stalled-slot retransmission) or targeted
+        (straggler help). Returns True when anything went out."""
+        sent = False
+        if state.echoed_hash is not None:
+            payload = state.contents.get(state.echoed_hash)
+            if payload is not None:
+                if peer is not None:
+                    self.mesh.send(peer, payload.encode())
+                else:
+                    self.mesh.broadcast(payload.encode())
+            self._send_attestation(
+                ECHO, slot[0], slot[1], state.echoed_hash, peer=peer
+            )
+            sent = True
+        if state.ready_sent and state.ready_hash is not None:
+            self._send_attestation(
+                READY, slot[0], slot[1], state.ready_hash, peer=peer
+            )
+            sent = True
+        if sent:
+            self.stats["retransmits"] += 1
+        return sent
+
+    def _resend_batch_slot(
+        self, slot, state: _BatchState, peer: Optional[Peer]
+    ) -> bool:
+        """Batch-plane twin of :meth:`_resend_slot`."""
+        sent = False
+        if state.echoed_hash is not None:
+            batch = state.contents.get(state.echoed_hash)
+            if batch is not None:
+                if peer is not None:
+                    self.mesh.send(peer, batch.encode())
+                else:
+                    self.mesh.broadcast(batch.encode())
+                sent = True
+            bits = state.own_echo_bits.get(state.echoed_hash, 0)
+            nbits = batch.count if batch is not None else state.nbits
+            if bits and nbits:
+                self._send_batch_attestation(
+                    BATCH_ECHO, slot, state.echoed_hash, bits, nbits, peer=peer
+                )
+                sent = True
+        if state.ready_hash is not None and state.ready_sent_bits:
+            rbatch = state.contents.get(state.ready_hash)
+            nbits = rbatch.count if rbatch is not None else state.nbits
+            if nbits:
+                self._send_batch_attestation(
+                    BATCH_READY,
+                    slot,
+                    state.ready_hash,
+                    state.ready_sent_bits,
+                    nbits,
+                    peer=peer,
+                )
+                sent = True
+        if sent:
+            self.stats["retransmits"] += 1
+        return sent
+
+    def _help_paced(self, state, peer: Peer, now: float) -> bool:
+        """Per-(slot, peer) pacing for straggler help: two stragglers on
+        one slot must not serialize behind a shared timestamp."""
+        last = state.helped_at.get(peer.sign_public, 0.0)
+        if now - last < RETRANSMIT_EVERY:
+            return False
+        state.helped_at[peer.sign_public] = now
+        return True
+
+    def _help_straggler(
+        self, peer: Optional[Peer], slot: Slot, state: _SlotState
+    ) -> None:
+        """Targeted repair: send our content copy + own attestations for
+        a DELIVERED slot directly to the peer whose duplicate attestation
+        marked it as stalled (see _pre_attestation)."""
+        if peer is not None and self._help_paced(state, peer, time.monotonic()):
+            self._resend_slot(slot, state, peer)
+
+    def _help_batch_straggler(
+        self, peer: Optional[Peer], slot, state: _BatchState
+    ) -> None:
+        """Batch-plane twin of :meth:`_help_straggler`."""
+        if peer is not None and self._help_paced(state, peer, time.monotonic()):
+            self._resend_batch_slot(slot, state, peer)
+
+    def _retransmit_slot(self, slot: Slot, state: _SlotState, now: float) -> bool:
+        """Stalled-slot liveness: re-broadcast this node's content copy
+        and own attestations for a slot still undelivered past
+        RETRANSMIT_AFTER (a lost echo/ready has no other recovery at
+        thresholds = n_peers; receivers that saw them dedup pre-verify)."""
+        if now - state.created < RETRANSMIT_AFTER:
+            return False
+        if now - state.retransmitted_at < RETRANSMIT_EVERY:
+            return False
+        if not self._resend_slot(slot, state, None):
+            return False
+        state.retransmitted_at = now
+        return True
+
+    def _retransmit_batch_slot(self, slot, state: _BatchState, now: float) -> bool:
+        """Batch-plane twin of :meth:`_retransmit_slot`."""
+        if now - state.created < RETRANSMIT_AFTER:
+            return False
+        if now - state.retransmitted_at < RETRANSMIT_EVERY:
+            return False
+        if not self._resend_batch_slot(slot, state, None):
+            return False
+        state.retransmitted_at = now
+        return True
 
     async def _worker(self) -> None:
         while True:
@@ -576,7 +749,7 @@ class Broadcast:
         actions = []  # (kind, msg, n_sigs)
         for peer, msg in chunk:
             if isinstance(msg, Payload):
-                if self._pre_gossip(msg):
+                if self._pre_gossip(msg):  # noqa: SIM102 (kept parallel)
                     to_verify.append(
                         (
                             msg.sender,
@@ -597,7 +770,7 @@ class Broadcast:
                     )
                     actions.append((BATCH, msg, 1 + len(entries)))
             elif isinstance(msg, BatchAttestation):
-                if self._pre_batch_attestation(msg):
+                if self._pre_batch_attestation(msg, peer):
                     to_verify.append((msg.origin, msg.to_sign(), msg.signature))
                     actions.append((msg.phase, msg, 1))
             elif isinstance(msg, ContentRequest):
@@ -614,7 +787,7 @@ class Broadcast:
                     except Exception:
                         logger.exception("catchup handler error")
             else:
-                if self._pre_attestation(msg):
+                if self._pre_attestation(msg, peer):
                     to_verify.append((msg.origin, msg.to_sign(), msg.signature))
                     actions.append((msg.phase, msg, 1))
         if not to_verify:
@@ -709,7 +882,9 @@ class Broadcast:
             or len(state.echoes.get(chash, ())) >= max(self.echo_threshold, 1)
         )
 
-    def _pre_attestation(self, att: Attestation) -> bool:
+    def _pre_attestation(
+        self, att: Attestation, peer: Optional[Peer] = None
+    ) -> bool:
         phase_key = "echo_rx" if att.phase == ECHO else "ready_rx"
         self.stats[phase_key] += 1
         if att.origin not in self.mesh.by_sign:
@@ -731,6 +906,15 @@ class Broadcast:
         # verification via *_by_origin below.
         seen_key = (att.phase, att.origin, slot, att.content_hash, att.signature)
         if seen_key in self._attest_seen:
+            # A DUPLICATE attestation for a slot we already delivered is
+            # a straggler's retransmission beacon (_retransmit_slot): its
+            # sender is stalled, and our vote may be the very one its
+            # loss took out — we stopped retransmitting when we
+            # delivered. Answer with our content + own attestations
+            # (paced; fresh late attestations don't trigger this).
+            state = self._slots.get(slot)
+            if state is not None and state.delivered:
+                self._help_straggler(peer, slot, state)
             return False
         self._attest_seen.add(seen_key)
         state = self._slots.get(slot)
@@ -893,7 +1077,9 @@ class Broadcast:
         ev = state.echo_votes.get(chash)
         return ev is not None and len(ev.by_origin) >= max(self.echo_threshold, 1)
 
-    def _pre_batch_attestation(self, att: BatchAttestation) -> bool:
+    def _pre_batch_attestation(
+        self, att: BatchAttestation, peer: Optional[Peer] = None
+    ) -> bool:
         key = "batch_echo_rx" if att.phase == BATCH_ECHO else "batch_ready_rx"
         self.stats[key] += 1
         if att.origin not in self.mesh.by_sign:
@@ -915,6 +1101,11 @@ class Broadcast:
             att.signature,
         )
         if seen_key in self._attest_seen:
+            # duplicate on a fully-delivered batch slot: straggler
+            # retransmission beacon — help (see _pre_attestation)
+            dstate = self._batch_slots.get(slot)
+            if dstate is not None and dstate.delivered_all:
+                self._help_batch_straggler(peer, slot, dstate)
             return False
         self._attest_seen.add(seen_key)
         state = self._batch_slots.get(slot)
@@ -1009,8 +1200,16 @@ class Broadcast:
             self._advance_batch(slot, state, att.batch_hash)
 
     def _send_batch_attestation(
-        self, phase: int, slot, chash: bytes, bits: int, nbits: int
+        self,
+        phase: int,
+        slot,
+        chash: bytes,
+        bits: int,
+        nbits: int,
+        peer: Optional[Peer] = None,
     ) -> None:
+        """Sign and send our batch Echo/Ready — broadcast by default,
+        targeted when ``peer`` is given (straggler help)."""
         bitmap = bits.to_bytes((nbits + 7) // 8, "little")
         sig = self.keypair.sign(
             BatchAttestation.signing_bytes(phase, slot[0], slot[1], chash, bitmap)
@@ -1018,7 +1217,10 @@ class Broadcast:
         att = BatchAttestation(
             phase, self.keypair.public, slot[0], slot[1], chash, bitmap, sig
         )
-        self.mesh.broadcast(att.encode())
+        if peer is not None:
+            self.mesh.send(peer, att.encode())
+        else:
+            self.mesh.broadcast(att.encode())
 
     def _advance_batch(self, slot, state: _BatchState, chash: bytes) -> None:
         """Drive per-entry phase transitions for one batch content."""
@@ -1136,11 +1338,21 @@ class Broadcast:
     # -- state transitions (synchronous; no awaits) -----------------------
 
     def _send_attestation(
-        self, phase: int, sender: bytes, sequence: int, chash: bytes
+        self,
+        phase: int,
+        sender: bytes,
+        sequence: int,
+        chash: bytes,
+        peer: Optional[Peer] = None,
     ) -> None:
+        """Sign and send our Echo/Ready — broadcast by default, targeted
+        when ``peer`` is given (straggler help)."""
         sig = self.keypair.sign(Attestation.signing_bytes(phase, sender, sequence, chash))
         att = Attestation(phase, self.keypair.public, sender, sequence, chash, sig)
-        self.mesh.broadcast(att.encode())
+        if peer is not None:
+            self.mesh.send(peer, att.encode())
+        else:
+            self.mesh.broadcast(att.encode())
 
     def _advance(self, slot: Slot, state: _SlotState, chash: bytes) -> None:
         """Drive the slot's phase transitions for one content hash."""
@@ -1156,6 +1368,7 @@ class Broadcast:
             state.sieve_delivered = True
             if not state.ready_sent:
                 state.ready_sent = True
+                state.ready_hash = chash
                 self._send_attestation(READY, slot[0], slot[1], chash)
         # contagion amplification: a full Ready quorum convinces a node
         # that missed the Echo phase to join (keeps delivery total)
@@ -1164,6 +1377,7 @@ class Broadcast:
             and len(state.readies[chash]) >= max(self.ready_threshold, 1)
         ):
             state.ready_sent = True
+            state.ready_hash = chash
             self._send_attestation(READY, slot[0], slot[1], chash)
         # deliver: enough readies AND the payload content is known
         if len(state.readies[chash]) >= self.ready_threshold and state.ready_sent:

@@ -232,6 +232,11 @@ class Service(At2Servicer):
                 ready_threshold=config.ready_threshold,
             )
             service.broadcast.catchup_handler = service._on_catchup
+            if config.catchup.enabled:
+                # broadcast GC signal: a slot stalled past push-
+                # retransmission recovers via the ledger-catchup plane
+                # (peers replay the committed payload from history)
+                service.broadcast.stall_handler = service._kick_catchup
             await service.mesh.start()
             await service.broadcast.start()
             service._delivery_task = asyncio.create_task(service._delivery_loop())

@@ -250,6 +250,132 @@ class TestPartitionHealContentPull:
                 await s.close()
 
 
+class TestStalledSlotRetransmission:
+    """Liveness under message loss (round-5): the planes are best-effort
+    (bounded queues drop under overload) and with thresholds = n_peers a
+    single lost attestation gap-blocks its slot network-wide — burst
+    measurements caught exactly that (BENCH_E2E.json batched_plane
+    burst_robustness). A slot still undelivered after RETRANSMIT_AFTER
+    re-broadcasts the node's content + own attestations (dedup absorbs
+    them wherever they already landed)."""
+
+    @staticmethod
+    def _speed_up(monkeypatch):
+        import at2_node_tpu.broadcast.stack as stack_mod
+
+        monkeypatch.setattr(stack_mod, "GC_INTERVAL", 0.3)
+        monkeypatch.setattr(stack_mod, "RETRANSMIT_AFTER", 0.5)
+        monkeypatch.setattr(stack_mod, "RETRANSMIT_EVERY", 0.5)
+
+    @staticmethod
+    def _drop_first(victim, pred):
+        """Drop the first message matching pred arriving at victim."""
+        state = {"dropped": 0}
+        original = victim.mesh.on_frame
+
+        async def lossy(peer, frame):
+            kept = []
+            for m in parse_frame(frame):
+                if state["dropped"] < 1 and pred(m):
+                    state["dropped"] += 1
+                    continue
+                kept.append(m)
+            if kept:
+                await original(peer, b"".join(m.encode() for m in kept))
+
+        victim.mesh.on_frame = lossy
+        return state
+
+    # The stalling shape (a single lost ECHO heals for free via Ready
+    # amplification): the FIRST Ready arriving at nodes 0 and 1 is
+    # dropped. Each then holds 1 of 2 required readies — permanently
+    # stalled pre-fix — while node 2 reaches its quorum and DELIVERS, so
+    # node 2 never retransmits. Recovery: the stalled nodes' periodic
+    # retransmission of their own attestations reaches node 2 as
+    # duplicates for a delivered slot (a straggler beacon), and node 2
+    # answers with its content + attestations (_help_straggler).
+
+    @pytest.mark.asyncio
+    async def test_lost_ready_recovered_per_tx(self, monkeypatch):
+        from at2_node_tpu.broadcast.messages import READY, Attestation
+        from at2_node_tpu.node.config import BatchingConfig
+
+        self._speed_up(monkeypatch)
+        cfgs = make_configs(3, batching=BatchingConfig(enabled=False))
+        services = [await Service.start(c) for c in cfgs]
+
+        def is_ready(m):
+            return isinstance(m, Attestation) and m.phase == READY
+
+        drops = [
+            self._drop_first(services[0], is_ready),
+            self._drop_first(services[1], is_ready),
+        ]
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset(sender, 1, recipient, 10)
+
+                async def all_committed():
+                    for s in services:
+                        if await s.accounts.get_last_sequence(sender.public) < 1:
+                            return False
+                    return True
+
+                await wait_until(
+                    all_committed, what="slot heals via retransmission"
+                )
+            assert all(d["dropped"] == 1 for d in drops), "fault never fired"
+            assert (
+                sum(s.broadcast.stats["retransmits"] for s in services) >= 1
+            )
+        finally:
+            for s in services:
+                await s.close()
+
+    @pytest.mark.asyncio
+    async def test_lost_batch_ready_recovered(self, monkeypatch):
+        from at2_node_tpu.broadcast.messages import (
+            BATCH_READY,
+            BatchAttestation,
+        )
+
+        self._speed_up(monkeypatch)
+        cfgs = make_configs(3)  # batching default-on
+        services = [await Service.start(c) for c in cfgs]
+
+        def is_bready(m):
+            return isinstance(m, BatchAttestation) and m.phase == BATCH_READY
+
+        drops = [
+            self._drop_first(services[0], is_bready),
+            self._drop_first(services[1], is_bready),
+        ]
+        sender = SignKeyPair.random()
+        recipient = SignKeyPair.random().public
+        try:
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset(sender, 1, recipient, 10)
+
+                async def all_committed():
+                    for s in services:
+                        if await s.accounts.get_last_sequence(sender.public) < 1:
+                            return False
+                    return True
+
+                await wait_until(
+                    all_committed, what="batch slot heals via retransmission"
+                )
+            assert all(d["dropped"] == 1 for d in drops), "fault never fired"
+            assert (
+                sum(s.broadcast.stats["retransmits"] for s in services) >= 1
+            )
+        finally:
+            for s in services:
+                await s.close()
+
+
 class TestBeyondHorizonRejoin:
     """VERDICT r4 #3/#4: the rejoin story when the gap EXCEEDS peers'
     bounded history horizon (ledger/history.py retention). Two halves:
