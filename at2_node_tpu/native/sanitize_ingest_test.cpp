@@ -205,6 +205,40 @@ int main() {
     add_frame(overrun);
   }
   add_frame({6, 1, 2, 3});                  // truncated HIST_IDX header
+  // batched broadcast plane (kinds 9-12): a 2-entry TxBatch, a batch
+  // whose count field overruns the cap (frame drops whole), a batch
+  // attestation with an 8-byte bitmap, one with bm_len > 128 (drops),
+  // and a BatchContentRequest
+  {
+    std::vector<uint8_t> batch{9};
+    for (int i = 0; i < 40; i++) batch.push_back(next());  // origin+seq
+    batch.push_back(2); batch.push_back(0); batch.push_back(0);
+    batch.push_back(0);                                    // count = 2
+    for (int i = 0; i < 64 + 2 * 140; i++) batch.push_back(next());
+    add_frame(batch);
+    std::vector<uint8_t> overcount{9};
+    for (int i = 0; i < 40; i++) overcount.push_back(next());
+    overcount.push_back(0x01); overcount.push_back(0x04);  // count 1025
+    overcount.push_back(0); overcount.push_back(0);
+    for (int i = 0; i < 64 + 140; i++) overcount.push_back(next());
+    add_frame(overcount);
+    std::vector<uint8_t> batt{10};
+    for (int i = 0; i < 104; i++) batt.push_back(next());  // header pre-len
+    batt.push_back(8); batt.push_back(0); batt.push_back(0);
+    batt.push_back(0);                                     // bm_len = 8
+    for (int i = 0; i < 8 + 64; i++) batt.push_back(next());
+    add_frame(batt);
+    std::vector<uint8_t> wide{11};
+    for (int i = 0; i < 104; i++) wide.push_back(next());
+    wide.push_back(0x81); wide.push_back(0);               // bm_len = 129
+    wide.push_back(0); wide.push_back(0);
+    for (int i = 0; i < 129 + 64; i++) wide.push_back(next());
+    add_frame(wide);
+    std::vector<uint8_t> breq(73, 0);
+    breq[0] = 12;
+    for (size_t i = 1; i < breq.size(); i++) breq[i] = next();
+    add_frame(breq);
+  }
 
   int64_t n_frames = int64_t(offsets.size()) - 1;
   int64_t cap = 64;
@@ -214,8 +248,9 @@ int main() {
   int64_t n = at2_parse_frames(flat.data(), offsets.data(), n_frames,
                                rows.data(), cap, msg_frame.data(),
                                frame_ok.data());
-  const uint8_t want_ok[12] = {1, 1, 1, 1, 1, 0, 0, 0, 1, 1, 0, 0};
-  if (n != 7 || std::memcmp(frame_ok.data(), want_ok, 12) != 0) {
+  const uint8_t want_ok[17] = {1, 1, 1, 1, 1, 0, 0, 0, 1, 1, 0, 0,
+                               1, 0, 1, 0, 1};
+  if (n != 10 || std::memcmp(frame_ok.data(), want_ok, 17) != 0) {
     std::fprintf(stderr, "FAIL: parse results n=%lld\n", (long long)n);
     return 1;
   }
