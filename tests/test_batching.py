@@ -459,6 +459,49 @@ class TestIngressBatcher:
             await close_all(services)
 
     @pytest.mark.asyncio
+    async def test_mixed_net_interop(self):
+        """A net where only SOME nodes batch at their ingress: batching
+        is a per-node ingress choice, not a protocol version — every
+        node understands relayed batches and per-tx payloads alike, and
+        traffic entering through either kind of ingress commits
+        everywhere."""
+        cfgs = make_configs(3)
+        cfgs[1].batching = BatchingConfig(enabled=False)
+        services = []
+        try:
+            for c in cfgs:
+                services.append(await Service.start(c))
+            from at2_node_tpu.client import Client
+
+            a = SignKeyPair.random()
+            b = SignKeyPair.random()
+            rcpt = SignKeyPair.random().public
+            # a's txs enter through the BATCHING node 0; b's through the
+            # per-tx node 1
+            async with Client(f"http://{cfgs[0].rpc_address}") as c0:
+                await c0.send_asset(a, 1, rcpt, 5)
+            async with Client(f"http://{cfgs[1].rpc_address}") as c1:
+                await c1.send_asset(b, 1, rcpt, 7)
+
+            async def all_committed():
+                for s in services:
+                    if await s.accounts.get_last_sequence(a.public) < 1:
+                        return False
+                    if await s.accounts.get_last_sequence(b.public) < 1:
+                        return False
+                return True
+
+            await wait_until(all_committed, what="mixed-plane commits")
+            for s in services:
+                assert await s.accounts.get_balance(rcpt) == FAUCET + 12
+            # each plane actually carried its tx
+            st = services[2].broadcast.stats
+            assert st["batch_entries_delivered"] >= 1
+            assert st["gossip_rx"] >= 1
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
     async def test_batching_disabled_uses_per_tx_plane(self):
         cfgs, services = await start_net(
             3, batching=BatchingConfig(enabled=False)
