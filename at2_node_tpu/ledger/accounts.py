@@ -106,6 +106,17 @@ class Accounts:
         async with self._lock:
             self._transfer(sender, sender_sequence, receiver, amount)
 
+    async def run_exclusive(self, fn):
+        """Run a synchronous multi-item ledger transaction under the
+        single-writer lock: ``fn(self)`` may call ``_transfer`` and the
+        ``*_nowait`` readers but MUST NOT await. One lock round-trip per
+        delivery-batch instead of per transfer — the commit path's cost
+        at batched-plane rates (BENCH_E2E.json batched_plane), with the
+        same linearizability argument: nothing interleaves a synchronous
+        critical section on a single event loop."""
+        async with self._lock:
+            return fn(self)
+
     def _transfer(
         self, sender: bytes, sender_sequence: int, receiver: bytes, amount: int
     ) -> None:

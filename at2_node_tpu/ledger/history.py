@@ -13,7 +13,7 @@ transferable is the history itself: committed payloads are client-signed
 the normal sequence gate deterministically re-converges the ledger.
 
 Every node therefore retains its recently committed payloads here
-(recorded by `node.service.Service._process_payload`) and serves them to
+(recorded by the commit pass in `node.service.Service._drain_to_fixpoint_locked`) and serves them to
 catching-up peers over the mesh (`HIST_IDX_REQ`/`HIST_REQ` messages,
 `broadcast/messages.py`). Retention is bounded: beyond ``cap`` total
 payloads the oldest are evicted FIFO, and a request older than the

@@ -53,29 +53,56 @@ class RecentTransactions:
                 )
             )
 
+    def _update_locked(
+        self, sender: bytes, sender_sequence: int, state: TransactionState
+    ) -> None:
+        """Flip the latest matching entry's state (caller holds the lock;
+        NOP when absent — a transaction may resolve after eviction)."""
+        for tx in reversed(self._ring):
+            if tx.sender_sequence == sender_sequence and tx.sender == sender:
+                tx.state = state
+                return
+
+    def _mark_failure_locked(
+        self, sender: bytes, sender_sequence: int
+    ) -> None:
+        """TTL marking for a stale (already-consumed-sequence) heap entry
+        (caller holds the lock): a catchup/delivery duplicate of a
+        COMMITTED transfer must not flip its twin's SUCCESS record, while
+        a genuinely failed transfer (its own debit consumed the sequence)
+        still gets the reference's FAILURE record
+        (`/root/reference/src/bin/server/rpc.rs:183-193`)."""
+        for tx in reversed(self._ring):
+            if tx.sender_sequence == sender_sequence and tx.sender == sender:
+                if tx.state is not TransactionState.SUCCESS:
+                    tx.state = TransactionState.FAILURE
+                return
+
     async def update(
         self, sender: bytes, sender_sequence: int, state: TransactionState
     ) -> None:
         async with self._lock:
-            for tx in reversed(self._ring):
-                if tx.sender_sequence == sender_sequence and tx.sender == sender:
-                    tx.state = state
-                    return
+            self._update_locked(sender, sender_sequence, state)
 
     async def mark_failure_unless_success(
         self, sender: bytes, sender_sequence: int
     ) -> None:
-        """TTL marking for a stale (already-consumed-sequence) heap entry:
-        a catchup/delivery duplicate of a COMMITTED transfer must not flip
-        its twin's SUCCESS record, while a genuinely failed transfer (its
-        own debit consumed the sequence) still gets the reference's
-        FAILURE record (`/root/reference/src/bin/server/rpc.rs:183-193`)."""
         async with self._lock:
-            for tx in reversed(self._ring):
-                if tx.sender_sequence == sender_sequence and tx.sender == sender:
-                    if tx.state is not TransactionState.SUCCESS:
-                        tx.state = TransactionState.FAILURE
-                    return
+            self._mark_failure_locked(sender, sender_sequence)
+
+    async def apply_many(self, ops: list) -> None:
+        """Apply an ordered batch of ring mutations under ONE lock
+        round-trip (the delivery loop collects a whole drain pass's
+        updates): ops are ``("update", sender, seq, state)`` or
+        ``("unless_success", sender, seq)`` rows, with exactly the same
+        per-op semantics as :meth:`update` /
+        :meth:`mark_failure_unless_success`."""
+        async with self._lock:
+            for op in ops:
+                if op[0] == "update":
+                    self._update_locked(op[1], op[2], op[3])
+                else:
+                    self._mark_failure_locked(op[1], op[2])
 
     async def export_state(self) -> list:
         """Snapshot for checkpointing (JSON-safe rows, oldest first)."""

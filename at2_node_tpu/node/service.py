@@ -497,59 +497,113 @@ class Service(At2Servicer):
                 break
             self._heap = []
             before = len(batch)
-            retry: List[tuple] = []
             batch.sort()
-            for key, added, tiebreak, payload in batch:
-                # An already-consumed sequence can never commit (the gate
-                # admits exactly last+1 and last only grows); keep it
-                # retrying until the reference's TTL so the ring records
-                # stay bit-identical with the reference, then drop it
-                # instead of parking it forever.
-                stale = payload.sequence <= self.accounts.last_sequence_nowait(
-                    payload.sender
-                )
-                if time.monotonic() - added > TRANSACTION_TTL:
-                    logger.warning(
-                        "transaction timed out: (%s, %d)",
-                        payload.sender.hex()[:16],
-                        payload.sequence,
+            now = time.monotonic()
+            catchup_keys = self._catchup_keys
+
+            def _apply_pass(accounts) -> tuple:
+                """One synchronous pass over the sorted batch under the
+                accounts lock (Accounts.run_exclusive): per-item stale /
+                TTL / transfer semantics identical to the reference's
+                loop (rpc.rs:176-208), but ONE lock round-trip for the
+                whole pass and the ring mutations collected for one bulk
+                apply — the commit path's per-tx actor overhead was the
+                top in-window cost at batched-plane rates."""
+                retry: List[tuple] = []
+                ring_ops: List[tuple] = []
+                commits: List[tuple] = []
+                for key, added, tiebreak, payload in batch:
+                    # An already-consumed sequence can never commit (the
+                    # gate admits exactly last+1 and last only grows);
+                    # keep it retrying until the reference's TTL so the
+                    # ring records stay bit-identical with the
+                    # reference, then drop it instead of parking it.
+                    stale = payload.sequence <= accounts.last_sequence_nowait(
+                        payload.sender
                     )
-                    if stale:
-                        # catchup/delivery duplicate of a committed slot,
-                        # or a transfer whose own failed debit consumed
-                        # the sequence: FAILURE-mark the latter, never
-                        # flip a committed twin's SUCCESS, and drop
-                        await self.recent.mark_failure_unless_success(
-                            payload.sender, payload.sequence
+                    if now - added > TRANSACTION_TTL:
+                        logger.warning(
+                            "transaction timed out: (%s, %d)",
+                            payload.sender.hex()[:16],
+                            payload.sequence,
                         )
-                        continue
-                    if key not in self._catchup_keys:
-                        # catchup-sourced payloads are quorum-confirmed
-                        # committed network-wide; a local gap-block must
-                        # not record FAILURE for a transfer every peer
-                        # reports SUCCESS (ADVICE r4) — it stays pending
-                        # until the gap resolves or the slot goes stale
-                        await self.recent.update(
+                        if stale:
+                            # catchup/delivery duplicate of a committed
+                            # slot, or a transfer whose own failed debit
+                            # consumed the sequence: FAILURE-mark the
+                            # latter, never flip a committed twin's
+                            # SUCCESS, and drop
+                            ring_ops.append(
+                                (
+                                    "unless_success",
+                                    payload.sender,
+                                    payload.sequence,
+                                )
+                            )
+                            continue
+                        if key not in catchup_keys:
+                            # catchup-sourced payloads are quorum-
+                            # confirmed committed network-wide; a local
+                            # gap-block must not record FAILURE for a
+                            # transfer every peer reports SUCCESS
+                            # (ADVICE r4)
+                            ring_ops.append(
+                                (
+                                    "update",
+                                    payload.sender,
+                                    payload.sequence,
+                                    TransactionState.FAILURE,
+                                )
+                            )
+                        # NO continue — TTL-expired payloads still
+                        # process and may flip to Success (reference
+                        # quirk, rpc.rs:183-205)
+                    try:
+                        accounts._transfer(
                             payload.sender,
                             payload.sequence,
-                            TransactionState.FAILURE,
+                            payload.transaction.recipient,
+                            payload.transaction.amount,
                         )
-                    # NO continue — TTL-expired payloads still process and
-                    # may flip to Success (reference quirk, rpc.rs:183-205)
-                try:
-                    await self._process_payload(payload)
-                    if key in self._catchup_keys:
-                        self._catchup_commits += 1
-                except AccountModificationError as exc:
-                    logger.debug(
-                        "retrying payload (%s, %d): %s",
-                        payload.sender.hex()[:16],
-                        payload.sequence,
-                        exc,
+                    except AccountModificationError as exc:
+                        logger.debug(
+                            "retrying payload (%s, %d): %s",
+                            payload.sender.hex()[:16],
+                            payload.sequence,
+                            exc,
+                        )
+                        retry.append((key, added, tiebreak, payload))
+                        continue
+                    except Exception as exc:
+                        logger.warning("dropping bad payload: %s", exc)
+                        continue
+                    ring_ops.append(
+                        (
+                            "update",
+                            payload.sender,
+                            payload.sequence,
+                            TransactionState.SUCCESS,
+                        )
                     )
-                    retry.append((key, added, tiebreak, payload))
-                except Exception as exc:
-                    logger.warning("dropping bad payload: %s", exc)
+                    commits.append((key, payload))
+                return retry, ring_ops, commits
+
+            retry, ring_ops, commits = await self.accounts.run_exclusive(
+                _apply_pass
+            )
+            for key, payload in commits:
+                logger.info(
+                    "new payload: seq=%d sender=%s",
+                    payload.sequence,
+                    payload.sender.hex()[:16],
+                )
+                self.committed += 1
+                if key in self._catchup_keys:
+                    self._catchup_commits += 1
+                # retain for peers' ledger catchup (ledger/history.py)
+                self.history.record(payload)
+            if ring_ops:
+                await self.recent.apply_many(ring_ops)
             # merge the leftovers with anything that arrived mid-pass; no
             # awaits between here and the key rebuild, so the set and the
             # heap cannot diverge
@@ -579,26 +633,6 @@ class Service(At2Servicer):
             and self.mesh.peers
         ):
             self._kick_catchup()
-
-    async def _process_payload(self, payload: Payload) -> None:
-        # rpc.rs:213-237: commit to the ledger, then flip the ring entry.
-        logger.info(
-            "new payload: seq=%d sender=%s",
-            payload.sequence,
-            payload.sender.hex()[:16],
-        )
-        await self.accounts.transfer(
-            payload.sender,
-            payload.sequence,
-            payload.transaction.recipient,
-            payload.transaction.amount,
-        )
-        await self.recent.update(
-            payload.sender, payload.sequence, TransactionState.SUCCESS
-        )
-        self.committed += 1
-        # retain for peers' ledger catchup (ledger/history.py)
-        self.history.record(payload)
 
     # -- ledger-history catchup ------------------------------------------
     #
