@@ -237,7 +237,25 @@ async def _agreement(services, identities):
 class TestByzantineWireFuzz:
     @pytest.mark.asyncio
     async def test_seeded_campaign(self):
-        campaign_seed = int(os.environ.get("AT2_FUZZ_SEED", "20260731"))
+        await self._campaign()
+
+    @pytest.mark.asyncio
+    async def test_seeded_campaign_native_reader_plane(self, monkeypatch):
+        """Same campaign with the C++ channel readers forced on: the
+        native inbound plane (socket reads, AEAD, frame assembly, wake
+        batching, chained delivery) faces the hostile frame generator
+        too."""
+        from at2_node_tpu.native.reader import _lib_with_reader
+
+        if _lib_with_reader() is None:
+            pytest.skip("native reader library unavailable")
+        monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
+        await self._campaign(seed_offset=1)
+
+    async def _campaign(self, seed_offset: int = 0):
+        campaign_seed = (
+            int(os.environ.get("AT2_FUZZ_SEED", "20260731")) + seed_offset
+        )
         cfgs = make_net_configs(5, _ports, echo_threshold=3, ready_threshold=3)
         services = [await Service.start(c) for c in cfgs[:4]]
         rng = random.Random(campaign_seed)
