@@ -21,7 +21,7 @@ import random
 
 import pytest
 
-from at2_node_tpu.broadcast.messages import Payload
+from at2_node_tpu.broadcast.messages import Payload, TxBatch
 from at2_node_tpu.broadcast.stack import Broadcast
 from at2_node_tpu.crypto.keys import SignKeyPair
 from at2_node_tpu.crypto.verifier import CpuVerifier
@@ -235,6 +235,55 @@ async def test_consistency_under_loss_and_equivocation(seed):
                 p = Payload(byz.public, 1, thin, byz.sign(thin.signing_bytes()))
                 honest_sigs.setdefault(byz.public, set()).add(p.signature)
                 await net.bcasts[node].broadcast(p)
+            await net.run_to_quiescence()
+            _check_safety(
+                [net.delivered(i) for i in range(net.n)], honest_sigs
+            )
+        finally:
+            await net.close()
+
+
+@pytest.mark.parametrize("seed", [7, 29, 61, 83])
+async def test_batch_plane_consistency_under_loss_and_equivocation(seed):
+    """The batched plane under the same adversarial schedules: random
+    loss + dup, a byzantine client racing conflicting same-(sender, seq)
+    entries through TWO different nodes' batch slots AND a third
+    conflicting content over the per-tx plane. The cross-plane entry
+    registry + per-entry quorum counting must keep consistency (at most
+    one content per slot network-wide) under every schedule; totality is
+    forfeit to loss by design."""
+    if True:
+        rng = random.Random(seed)
+        net = AdversarialNet(4, rng, dup=0.2, drop=0.15, threshold=None)
+        await net.start()
+        honest = SignKeyPair.random()
+        byz = SignKeyPair.random()
+        honest_sigs = {}
+        try:
+            # an honest 3-entry batch slot from node 0
+            entries = []
+            for seq in (1, 2, 3):
+                p = _signed_payload(honest, seq)
+                honest_sigs.setdefault(honest.public, set()).add(p.signature)
+                entries.append(p)
+            raw = b"".join(p.encode()[1:] for p in entries)
+            await net.bcasts[0].broadcast_batch(
+                TxBatch.create(net.keys[0], 1, raw)
+            )
+            # byzantine client: conflicting (byz, 1) entries ride two
+            # different honest nodes' batch slots
+            for amount, node in ((111, 1), (222, 2)):
+                thin = ThinTransaction(b"r" * 32, amount)
+                p = Payload(byz.public, 1, thin, byz.sign(thin.signing_bytes()))
+                honest_sigs.setdefault(byz.public, set()).add(p.signature)
+                await net.bcasts[node].broadcast_batch(
+                    TxBatch.create(net.keys[node], 7, p.encode()[1:])
+                )
+            # ...and a third conflicting content over the per-tx plane
+            thin = ThinTransaction(b"r" * 32, 333)
+            p = Payload(byz.public, 1, thin, byz.sign(thin.signing_bytes()))
+            honest_sigs[byz.public].add(p.signature)
+            await net.bcasts[3].broadcast(p)
             await net.run_to_quiescence()
             _check_safety(
                 [net.delivered(i) for i in range(net.n)], honest_sigs
