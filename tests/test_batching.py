@@ -525,6 +525,55 @@ class TestIngressBatcher:
             await close_all(services)
 
 
+class TestSlotLifecycle:
+    @pytest.mark.asyncio
+    async def test_batch_slots_compact_and_counters_balance(self, monkeypatch):
+        """GC lifecycle of the batched plane: delivered batch slots
+        compact into the bounded delivered-set after retention, the
+        undelivered counter returns to zero (its imbalance would
+        eventually wedge the MAX_LIVE_SLOTS admission cap), and the
+        commit heap fully drains."""
+        import at2_node_tpu.broadcast.stack as stack_mod
+
+        monkeypatch.setattr(stack_mod, "GC_INTERVAL", 0.2)
+        monkeypatch.setattr(stack_mod, "DELIVERED_RETENTION", 0.3)
+        cfgs, services = await start_net(3)
+        try:
+            sender = SignKeyPair.random()
+            recipient = SignKeyPair.random().public
+            # several flushes => several batch slots per node
+            seq = 0
+            for _ in range(5):
+                for _ in range(20):
+                    seq += 1
+                    await submit(
+                        services[0],
+                        make_payload(sender, seq=seq, recipient=recipient),
+                    )
+                await services[0]._flush_batch()
+
+            async def all_committed():
+                return all(s.committed >= seq for s in services)
+
+            await wait_until(all_committed, what="soak commits")
+
+            async def compacted():
+                for s in services:
+                    b = s.broadcast
+                    if b._batch_slots or b._undelivered != 0:
+                        return False
+                    if len(b._delivered_batch_slots) < 5:
+                        return False
+                return True
+
+            await wait_until(compacted, what="batch slots compact")
+            for s in services:
+                assert not s._heap and not s._heap_keys
+                assert await s.accounts.get_balance(recipient) == FAUCET + 10 * seq
+        finally:
+            await close_all(services)
+
+
 class TestConfig:
     def test_toml_roundtrip(self):
         cfg = make_configs(1)[0]
