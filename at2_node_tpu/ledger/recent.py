@@ -33,25 +33,38 @@ class RecentTransactions:
         self._ring: Deque[FullTransaction] = deque()
         self._lock = asyncio.Lock()
 
+    def _put_locked(
+        self, sender: bytes, sender_sequence: int, thin: ThinTransaction
+    ) -> None:
+        for tx in self._ring:
+            if tx.sender_sequence == sender_sequence and tx.sender == sender:
+                return
+        if len(self._ring) == LATEST_TRANSACTIONS_MAX_SIZE:
+            self._ring.popleft()
+        self._ring.append(
+            FullTransaction(
+                timestamp=datetime.datetime.now(datetime.timezone.utc),
+                sender=sender,
+                sender_sequence=sender_sequence,
+                recipient=thin.recipient,
+                amount=thin.amount,
+                state=TransactionState.PENDING,
+            )
+        )
+
     async def put(
         self, sender: bytes, sender_sequence: int, thin: ThinTransaction
     ) -> None:
         async with self._lock:
-            for tx in self._ring:
-                if tx.sender_sequence == sender_sequence and tx.sender == sender:
-                    return
-            if len(self._ring) == LATEST_TRANSACTIONS_MAX_SIZE:
-                self._ring.popleft()
-            self._ring.append(
-                FullTransaction(
-                    timestamp=datetime.datetime.now(datetime.timezone.utc),
-                    sender=sender,
-                    sender_sequence=sender_sequence,
-                    recipient=thin.recipient,
-                    amount=thin.amount,
-                    state=TransactionState.PENDING,
-                )
-            )
+            self._put_locked(sender, sender_sequence, thin)
+
+    async def put_many(self, rows: list) -> None:
+        """Insert many Pending records under ONE lock round-trip
+        (SendAssetBatch ingress): rows are ``(sender, sequence, thin)``,
+        per-row semantics identical to :meth:`put`."""
+        async with self._lock:
+            for sender, seq, thin in rows:
+                self._put_locked(sender, seq, thin)
 
     def _update_locked(
         self, sender: bytes, sender_sequence: int, state: TransactionState

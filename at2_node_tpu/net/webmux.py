@@ -62,6 +62,7 @@ _MAX_HTTP1_CONNS = 512
 # back from the servicer call itself)
 _REQUEST_TYPES: Dict[str, type] = {
     "SendAsset": pb.SendAssetRequest,
+    "SendAssetBatch": pb.SendAssetBatchRequest,
     "GetBalance": pb.GetBalanceRequest,
     "GetLastSequence": pb.GetLastSequenceRequest,
     "GetLatestTransactions": pb.GetLatestTransactionsRequest,

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import grpc
 
 from ..broadcast.messages import (
+    MAX_BATCH_ENTRIES,
     HistoryBatch,
     HistoryIndex,
     HistoryIndexRequest,
@@ -895,20 +896,24 @@ class Service(At2Servicer):
     # -- ingress batching (broadcast/stack.py batched plane) --------------
 
     async def _flush_batch(self) -> None:
-        """Flush the accumulated SendAsset payloads as ONE batch slot.
-        Synchronous swap at entry makes concurrent flushes (size trigger
-        racing the window timer) idempotent: the loser sees an empty
-        buffer."""
-        buf = self._batch_buf
-        if not buf:
-            return
-        self._batch_buf = []
-        self._batch_seq += 1
-        entries_raw = b"".join(p.encode()[1:] for p in buf)
-        batch = TxBatch.create(
-            self.config.sign_key, self._batch_seq, entries_raw
-        )
-        await self.broadcast.broadcast_batch(batch)
+        """Flush the accumulated SendAsset payloads as batch slots (one
+        per max_entries chunk — SendAssetBatch can land more than one
+        slot's worth at once; a slot must never exceed the wire's entry
+        cap). Synchronous SNAPSHOT at entry: concurrent flushes (size
+        trigger racing the window timer) see an empty buffer, and
+        payloads that arrive while a broadcast_batch below is suspended
+        wait for their own window/size trigger instead of leaking out as
+        undersized slots (or keeping this flush looping unboundedly)."""
+        buf, self._batch_buf = self._batch_buf, []
+        limit = self.config.batching.max_entries
+        for lo in range(0, len(buf), limit):
+            chunk = buf[lo : lo + limit]
+            self._batch_seq += 1
+            entries_raw = b"".join(p.encode()[1:] for p in chunk)
+            batch = TxBatch.create(
+                self.config.sign_key, self._batch_seq, entries_raw
+            )
+            await self.broadcast.broadcast_batch(batch)
 
     async def _delayed_flush(self, window: float) -> None:
         # Loop until the buffer is observed empty: a payload that arrived
@@ -925,35 +930,74 @@ class Service(At2Servicer):
 
     # -- gRPC handlers (rpc.rs:256-344) ----------------------------------
 
-    async def SendAsset(self, request, context):
+    @staticmethod
+    async def _validated_payload(request, context, where: str = "") -> Payload:
         if len(request.sender) != 32 or len(request.recipient) != 32:
             await context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT, "keys must be 32 bytes"
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"keys must be 32 bytes{where}",
             )
         if len(request.signature) != 64:
             await context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT, "signature must be 64 bytes"
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"signature must be 64 bytes{where}",
             )
         try:
             thin = ThinTransaction(request.recipient, request.amount)
         except ValueError as exc:
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-        await self.recent.put(request.sender, request.sequence, thin)
-        payload = Payload(request.sender, request.sequence, thin, request.signature)
-        # fire-and-forget: the ACK is not a commit receipt (rpc.rs:286)
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"{exc}{where}"
+            )
+        return Payload(request.sender, request.sequence, thin, request.signature)
+
+    async def _ingest(self, payloads: List[Payload]) -> None:
+        """Common ingress tail for SendAsset / SendAssetBatch: ring
+        Pending records, then the batcher (or the per-tx plane).
+        Fire-and-forget: the ACK is not a commit receipt (rpc.rs:286)."""
+        await self.recent.put_many(
+            [(p.sender, p.sequence, p.transaction) for p in payloads]
+        )
         bcfg = self.config.batching
         if not bcfg.enabled or self._closing:
             # during shutdown, skip the batcher: a flush timer spawned
             # after close() cancelled the old one would be orphaned
-            await self.broadcast.broadcast(payload)
-            return pb.SendAssetReply()
-        self._batch_buf.append(payload)
+            for p in payloads:
+                await self.broadcast.broadcast(p)
+            return
+        self._batch_buf.extend(payloads)
         if len(self._batch_buf) >= bcfg.max_entries:
             await self._flush_batch()
         elif self._batch_flush_task is None or self._batch_flush_task.done():
             self._batch_flush_task = asyncio.create_task(
                 self._delayed_flush(bcfg.window)
             )
+
+    async def SendAsset(self, request, context):
+        payload = await self._validated_payload(request, context)
+        await self._ingest([payload])
+        return pb.SendAssetReply()
+
+    async def SendAssetBatch(self, request, context):
+        """Beyond-parity bulk ingress (at2.proto documents the contract):
+        semantically identical to one SendAsset per entry, one RPC
+        round-trip. The whole request is validated before any entry is
+        admitted (all-or-nothing admission; commit outcomes stay
+        per-entry, exactly like separate SendAssets)."""
+        if not request.transactions:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "empty batch"
+            )
+        if len(request.transactions) > MAX_BATCH_ENTRIES:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"batch exceeds {MAX_BATCH_ENTRIES} transactions",
+            )
+        payloads = []
+        for i, req in enumerate(request.transactions):
+            payloads.append(
+                await self._validated_payload(req, context, f" (entry {i})")
+            )
+        await self._ingest(payloads)
         return pb.SendAssetReply()
 
     async def GetBalance(self, request, context):

@@ -20,6 +20,7 @@ SERVICE_NAME = "at2.AT2"
 # block one-to-one.
 _METHODS = {
     "SendAsset": (pb.SendAssetRequest, pb.SendAssetReply),
+    "SendAssetBatch": (pb.SendAssetBatchRequest, pb.SendAssetReply),
     "GetBalance": (pb.GetBalanceRequest, pb.GetBalanceReply),
     "GetLastSequence": (pb.GetLastSequenceRequest, pb.GetLastSequenceReply),
     "GetLatestTransactions": (
@@ -33,6 +34,9 @@ class At2Servicer:
     """Subclass and override the four handlers, then `add_to_server`."""
 
     async def SendAsset(self, request, context):
+        raise NotImplementedError
+
+    async def SendAssetBatch(self, request, context):
         raise NotImplementedError
 
     async def GetBalance(self, request, context):

@@ -43,29 +43,50 @@ class LoadResult:
 
 
 async def _client_worker(
-    uri: str, keypair: SignKeyPair, n_tx: int, window: int
+    uri: str, keypair: SignKeyPair, n_tx: int, window: int, rpc_batch: int = 1
 ) -> int:
     """Issue n_tx self-transfers with sequences 1..n_tx, keeping up to
-    ``window`` requests in flight (a firehose, not a lockstep loop)."""
+    ``window`` requests in flight (a firehose, not a lockstep loop).
+    ``rpc_batch`` > 1 ships them ``rpc_batch`` per SendAssetBatch call
+    (the beyond-parity bulk ingress) instead of one per SendAsset."""
     sent = 0
     window = max(window, 1)
     async with Client(uri) as client:
         pending: set = set()
-        for seq in range(1, n_tx + 1):
-            if len(pending) >= window:
-                done, pending = await asyncio.wait(
-                    pending, return_when=asyncio.FIRST_COMPLETED
+
+        async def _drain_one():
+            nonlocal pending, sent
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                t.result()
+                sent += t.tx_count
+
+        if rpc_batch > 1:
+            for lo in range(1, n_tx + 1, rpc_batch):
+                seqs = range(lo, min(lo + rpc_batch, n_tx + 1))
+                if len(pending) >= window:
+                    await _drain_one()
+                task = asyncio.create_task(
+                    client.send_asset_many(
+                        keypair, [(s, keypair.public, 1) for s in seqs]
+                    )
                 )
-                for t in done:
-                    t.result()
-                    sent += 1
-            pending.add(
-                asyncio.create_task(
+                task.tx_count = len(seqs)
+                pending.add(task)
+        else:
+            for seq in range(1, n_tx + 1):
+                if len(pending) >= window:
+                    await _drain_one()
+                task = asyncio.create_task(
                     client.send_asset(keypair, seq, keypair.public, 1)
                 )
-            )
-        for t in await asyncio.gather(*pending):
-            sent += 1
+                task.tx_count = 1
+                pending.add(task)
+        for t in pending:
+            await t
+            sent += t.tx_count
     return sent
 
 
@@ -98,12 +119,15 @@ async def run_load(
     tx_per_client: int = 100,
     window: int = 8,
     commit_timeout: float = 120.0,
+    rpc_batch: int = 1,
 ) -> LoadResult:
     keypairs = [SignKeyPair.random() for _ in range(clients)]
     t0 = time.monotonic()
     sent = await asyncio.gather(
         *(
-            _client_worker(rpcs[i % len(rpcs)], kp, tx_per_client, window)
+            _client_worker(
+                rpcs[i % len(rpcs)], kp, tx_per_client, window, rpc_batch
+            )
             for i, kp in enumerate(keypairs)
         )
     )
@@ -132,6 +156,9 @@ def main(argv=None) -> int:
     ap.add_argument("--tx-per-client", type=int, default=100)
     ap.add_argument("--window", type=int, default=8)
     ap.add_argument("--commit-timeout", type=float, default=120.0)
+    ap.add_argument("--rpc-batch", type=int, default=1,
+                    help="transfers per SendAssetBatch call (1 = unary "
+                    "SendAsset, reference-parity surface)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -142,6 +169,7 @@ def main(argv=None) -> int:
             tx_per_client=args.tx_per_client,
             window=args.window,
             commit_timeout=args.commit_timeout,
+            rpc_batch=args.rpc_batch,
         )
     )
     if args.json:

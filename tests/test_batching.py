@@ -525,6 +525,130 @@ class TestIngressBatcher:
             await close_all(services)
 
 
+class TestSendAssetBatchRpc:
+    """The beyond-parity bulk-ingress RPC (at2.proto SendAssetBatch):
+    semantically one SendAsset per entry, one round-trip."""
+
+    @pytest.mark.asyncio
+    async def test_bulk_submit_commits_everywhere(self):
+        cfgs, services = await start_net(3)
+        try:
+            from at2_node_tpu.client import Client
+
+            sender = SignKeyPair.random()
+            rcpt = SignKeyPair.random().public
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset_many(
+                    sender, [(s, rcpt, 2) for s in range(1, 101)]
+                )
+
+                async def all_committed():
+                    seqs = [
+                        await s.accounts.get_last_sequence(sender.public)
+                        for s in services
+                    ]
+                    return all(q == 100 for q in seqs)
+
+                await wait_until(all_committed, what="bulk RPC commits")
+            for s in services:
+                assert await s.accounts.get_balance(rcpt) == FAUCET + 200
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_validation_all_or_nothing(self):
+        import grpc
+
+        from at2_node_tpu.proto import at2_pb2 as pb
+        from at2_node_tpu.proto.rpc import At2Stub
+
+        cfgs, services = await start_net(1)
+        try:
+            sender = SignKeyPair.random()
+            rcpt = SignKeyPair.random().public
+            good = pb.SendAssetRequest(
+                sender=sender.public, sequence=1, recipient=rcpt,
+                amount=5, signature=b"s" * 64,
+            )
+            bad = pb.SendAssetRequest(  # 31-byte recipient
+                sender=sender.public, sequence=2, recipient=b"x" * 31,
+                amount=5, signature=b"s" * 64,
+            )
+            channel = grpc.aio.insecure_channel(cfgs[0].rpc_address)
+            stub = At2Stub(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await stub.SendAssetBatch(
+                    pb.SendAssetBatchRequest(transactions=[good, bad])
+                )
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "entry 1" in exc.value.details()
+            with pytest.raises(grpc.aio.AioRpcError):
+                await stub.SendAssetBatch(pb.SendAssetBatchRequest())
+            # nothing was admitted from the failed batch
+            await asyncio.sleep(0.1)
+            assert services[0].committed == 0
+            assert not services[0]._batch_buf
+            await channel.close()
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_oversized_rpc_batch_rejected(self):
+        import grpc
+
+        from at2_node_tpu.proto import at2_pb2 as pb
+        from at2_node_tpu.proto.rpc import At2Stub
+
+        cfgs, services = await start_net(1)
+        try:
+            sender = SignKeyPair.random()
+            rcpt = SignKeyPair.random().public
+            reqs = [
+                pb.SendAssetRequest(
+                    sender=sender.public, sequence=s, recipient=rcpt,
+                    amount=1, signature=b"s" * 64,
+                )
+                for s in range(1, MAX_BATCH_ENTRIES + 2)
+            ]
+            channel = grpc.aio.insecure_channel(cfgs[0].rpc_address)
+            stub = At2Stub(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await stub.SendAssetBatch(
+                    pb.SendAssetBatchRequest(transactions=reqs)
+                )
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            await channel.close()
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
+    async def test_flush_chunks_respect_wire_cap(self):
+        """An ingress burst larger than max_entries flushes as MULTIPLE
+        slots, none exceeding the wire cap."""
+        cfgs, services = await start_net(
+            1, batching=BatchingConfig(enabled=True, max_entries=16)
+        )
+        svc = services[0]
+        try:
+            from at2_node_tpu.client import Client
+
+            sender = SignKeyPair.random()
+            rcpt = SignKeyPair.random().public
+            async with Client(f"http://{cfgs[0].rpc_address}") as client:
+                await client.send_asset_many(
+                    sender, [(s, rcpt, 1) for s in range(1, 41)]
+                )
+
+                async def committed():
+                    return svc.committed >= 40
+
+                await wait_until(committed, what="chunked flush commits")
+            # 40 entries / cap 16 => at least 3 slots
+            assert svc.broadcast.stats["batch_rx"] >= 3
+        finally:
+            await close_all(services)
+
+
 class TestSlotLifecycle:
     @pytest.mark.asyncio
     async def test_batch_slots_compact_and_counters_balance(self, monkeypatch):
