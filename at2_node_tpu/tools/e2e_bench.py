@@ -68,7 +68,13 @@ def _wait_port(port: int, timeout: float = 60.0) -> None:
     raise TimeoutError(f"port {port} never came up")
 
 
-def _phase_cpu_subprocess(n_nodes: int, clients: int, tx_per_client: int) -> dict:
+def _phase_cpu_subprocess(
+    n_nodes: int,
+    clients: int,
+    tx_per_client: int,
+    rpc_batch: int = 1,
+    window: int = 8,
+) -> dict:
     from .loadgen import run_load
 
     ports = [(next(_ports), next(_ports)) for _ in range(n_nodes)]
@@ -106,13 +112,16 @@ def _phase_cpu_subprocess(n_nodes: int, clients: int, tx_per_client: int) -> dic
                 rpcs,
                 clients=clients,
                 tx_per_client=tx_per_client,
-                window=8,
+                window=window,
                 commit_timeout=600.0,
+                rpc_batch=rpc_batch,
             )
         )
         return {
             "nodes": n_nodes,
             "topology": "4 server subprocesses, CPU verifier",
+            "rpc_batch": rpc_batch,
+            "window": window,
             "clients": clients,
             "submitted": result.submitted,
             "committed": result.committed,
@@ -131,7 +140,7 @@ def _phase_cpu_subprocess(n_nodes: int, clients: int, tx_per_client: int) -> dic
 
 
 async def _phase_tpu_inprocess(
-    n_nodes: int, clients: int, tx_per_client: int
+    n_nodes: int, clients: int, tx_per_client: int, rpc_batch: int = 1
 ) -> dict:
     from ..crypto.keys import ExchangeKeyPair, SignKeyPair
     from ..crypto.verifier import TpuBatchVerifier
@@ -156,12 +165,14 @@ async def _phase_tpu_inprocess(
             tx_per_client=tx_per_client,
             window=8,
             commit_timeout=600.0,
+            rpc_batch=rpc_batch,
         )
         vstats = shared.stats()
         bstats = services[0].snapshot_stats()
         return {
             "nodes": n_nodes,
             "topology": "4 in-process nodes sharing one TpuBatchVerifier",
+            "rpc_batch": rpc_batch,
             "clients": clients,
             "submitted": result.submitted,
             "committed": result.committed,
@@ -190,6 +201,13 @@ def main(argv=None) -> int:
     ap.add_argument("--nodes", type=int, default=4)
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--tx-per-client", type=int, default=50)
+    ap.add_argument("--window", type=int, default=8,
+                    help="in-flight RPCs per client (in-flight TRANSFERS "
+                    "= window x rpc_batch; match them when A/B-ing "
+                    "unary vs bulk ingress)")
+    ap.add_argument("--rpc-batch", type=int, default=1,
+                    help="transfers per SendAssetBatch call (1 = unary "
+                    "SendAsset, the reference-parity surface)")
     ap.add_argument("--skip-cpu", action="store_true")
     ap.add_argument("--skip-tpu", action="store_true")
     ap.add_argument("--out", default=None)
@@ -204,11 +222,14 @@ def main(argv=None) -> int:
     }
     if not args.skip_cpu:
         artifact["cpu_subprocess"] = _phase_cpu_subprocess(
-            args.nodes, args.clients, args.tx_per_client
+            args.nodes, args.clients, args.tx_per_client, args.rpc_batch,
+            args.window,
         )
     if not args.skip_tpu:
         artifact["tpu_inprocess"] = asyncio.run(
-            _phase_tpu_inprocess(args.nodes, args.clients, args.tx_per_client)
+            _phase_tpu_inprocess(
+                args.nodes, args.clients, args.tx_per_client, args.rpc_batch
+            )
         )
     out = json.dumps(artifact)
     print(out)
