@@ -622,6 +622,33 @@ class TestSendAssetBatchRpc:
             await close_all(services)
 
     @pytest.mark.asyncio
+    async def test_client_chunks_past_server_cap(self, monkeypatch):
+        """send_asset_many transparently splits lists beyond the server's
+        per-request cap into multiple RPCs, in order."""
+        import at2_node_tpu.client as client_mod
+
+        monkeypatch.setattr(client_mod, "_RPC_BATCH_CAP", 10)
+        cfgs, services = await start_net(1)
+        try:
+            sender = SignKeyPair.random()
+            rcpt = SignKeyPair.random().public
+            async with client_mod.Client(f"http://{cfgs[0].rpc_address}") as c:
+                await c.send_asset_many(
+                    sender, [(s, rcpt, 1) for s in range(1, 26)]
+                )
+
+                async def committed():
+                    return services[0].committed >= 25
+
+                await wait_until(committed, what="chunked client commits")
+            assert (
+                await services[0].accounts.get_last_sequence(sender.public)
+                == 25
+            )
+        finally:
+            await close_all(services)
+
+    @pytest.mark.asyncio
     async def test_flush_chunks_respect_wire_cap(self):
         """An ingress burst larger than max_entries flushes as MULTIPLE
         slots, none exceeding the wire cap."""

@@ -225,3 +225,64 @@ def test_sign_verify_roundtrip():
     sig = keypair.sign(thin.signing_bytes())
     assert verify_one(keypair.public, thin.signing_bytes(), sig)
     assert not verify_one(keypair.public, b"other message", sig)
+
+
+# -- bulk ring/ledger operations (round 5: one lock round-trip per batch) --
+
+
+@pytest.mark.asyncio
+async def test_put_many_matches_per_item_put_semantics():
+    ring = RecentTransactions()
+    s1, s2 = b"\x01" * 32, b"\x02" * 32
+    thin = ThinTransaction(b"\x03" * 32, 5)
+    # dedup inside one bulk call AND against prior entries
+    await ring.put(s1, 1, thin)
+    await ring.put_many([(s1, 1, thin), (s1, 2, thin), (s2, 1, thin), (s1, 2, thin)])
+    txs = await ring.get_all()
+    assert [(t.sender, t.sender_sequence) for t in txs] == [
+        (s1, 1), (s1, 2), (s2, 1)
+    ]
+    assert all(t.state is TransactionState.PENDING for t in txs)
+
+
+@pytest.mark.asyncio
+async def test_apply_many_order_and_unless_success():
+    ring = RecentTransactions()
+    s = b"\x04" * 32
+    thin = ThinTransaction(b"\x05" * 32, 5)
+    await ring.put_many([(s, 1, thin), (s, 2, thin)])
+    # ordered application: FAILURE then SUCCESS for seq 1 -> final SUCCESS;
+    # unless_success on seq 1 afterwards must NOT flip it back; seq 2's
+    # unless_success (still PENDING) must mark FAILURE
+    await ring.apply_many(
+        [
+            ("update", s, 1, TransactionState.FAILURE),
+            ("update", s, 1, TransactionState.SUCCESS),
+            ("unless_success", s, 1),
+            ("unless_success", s, 2),
+        ]
+    )
+    states = {t.sender_sequence: t.state for t in await ring.get_all()}
+    assert states == {
+        1: TransactionState.SUCCESS,
+        2: TransactionState.FAILURE,
+    }
+
+
+@pytest.mark.asyncio
+async def test_run_exclusive_applies_and_returns():
+    accounts = Accounts()
+    a, b = b"\x06" * 32, b"\x07" * 32
+
+    def txn(acc):
+        acc._transfer(a, 1, b, 100)
+        try:
+            acc._transfer(a, 1, b, 100)  # duplicate sequence
+        except AccountModificationError as exc:
+            return exc
+        return None
+
+    err = await accounts.run_exclusive(txn)
+    assert isinstance(err, AccountModificationError)
+    assert await accounts.get_balance(b) == INITIAL_BALANCE + 100
+    assert await accounts.get_last_sequence(a) == 1
