@@ -166,6 +166,19 @@ RETRANSMIT_BUDGET_PER_PASS = 64
 # the ledger-catchup plane (stall_handler -> node.service._kick_catchup),
 # which replays the committed slot from peers' history stores.
 STALLED_CATCHUP_AFTER = 30.0
+# Stall-storm damping (hysteresis on stall_handler): consecutive kicks
+# are spaced at least STALL_KICK_MIN_INTERVAL apart, doubling up to
+# STALL_KICK_MAX_INTERVAL while the stall persists, and the interval
+# resets once a GC pass sees no stalled slot. Without this, ONE slot
+# parked past STALLED_CATCHUP_AFTER fires a network-wide catchup kick
+# every GC_INTERVAL for up to SLOT_MAX_AGE — the amplification lever the
+# per-slot resolution tracking closes (ADVICE.md stack.py:1296).
+STALL_KICK_MIN_INTERVAL = 30.0
+STALL_KICK_MAX_INTERVAL = 300.0
+# Entry-registry bound (see Broadcast._entry_registry): sized so FIFO
+# eviction cannot reopen the equivocation window for LIVE slots — see
+# the safety comment at the construction site.
+ENTRY_REGISTRY_CAP = 1 << 22
 # Max messages one worker drains from the inbox per iteration: the unit of
 # bulk verification (one verify_many call -> one slice of the TPU batch).
 WORKER_CHUNK = 256
@@ -220,6 +233,9 @@ class _BoundedDict:
             if len(self._items) >= self._cap:
                 self._items.pop(next(iter(self._items)))
         self._items[key] = value
+
+    def pop(self, key, default=None):
+        return self._items.pop(key, default)
 
     def __contains__(self, key) -> bool:
         return key in self._items
@@ -300,7 +316,9 @@ class _BatchState:
         "ready_hash",
         "ready_sent_bits",
         "delivered_bits",
+        "rejected_bits",
         "delivered_all",
+        "retired",
         "nbits",
     )
 
@@ -326,7 +344,15 @@ class _BatchState:
         self.ready_hash: Optional[bytes] = None
         self.ready_sent_bits = 0  # our cumulative Ready bits (ready_hash)
         self.delivered_bits: Dict[bytes, int] = {}  # hash -> delivered bits
+        # entries WE rejected at echo time (bad client signature or an
+        # equivocation-registry conflict) — the resolution complement of
+        # delivered_bits: an entry is RESOLVED when delivered or rejected
+        self.rejected_bits: Dict[bytes, int] = {}
         self.delivered_all = False  # some content fully delivered
+        # every ready-quorate entry delivered, every remaining entry
+        # locally resolved-rejected: the slot can never progress further
+        # and must not count as stalled (see _maybe_retire_batch)
+        self.retired = False
         self.nbits = 0  # widest entry count seen (content or bitmap bound)
 
 
@@ -399,7 +425,22 @@ class Broadcast:
         # entry content ACROSS both planes — sieve's per-slot guarantee
         self._batch_slots: Dict[Tuple[bytes, int], _BatchState] = {}
         self._delivered_batch_slots = _BoundedSet(DEDUP_CAP)
-        self._entry_registry = _BoundedDict(DEDUP_CAP)
+        # Registry retention is scoped to LIVE (uncommitted) sequences:
+        # the service drops a binding via release_entry() once its
+        # sequence passes the ledger gate, where the per-account sequence
+        # check subsumes the registry's job (a conflicting content for a
+        # committed seq can never commit again). Safety of the FIFO cap:
+        # the theoretical live bound is MAX_LIVE_SLOTS x
+        # MAX_BATCH_ENTRIES (2^17 x 2^10 = 2^27) bindings, far past what
+        # fits in RAM — but per-tx slots bind at most one entry each
+        # (<= MAX_LIVE_SLOTS = 2^17 total) and batch slots exist only
+        # under the n known node identities, so 2^22 covers the per-tx
+        # worst case plus ~4000 full in-flight batches (4M entries,
+        # >> any real in-flight window at the 10k tx/s target). Eviction
+        # at the cap therefore only ever sheds bindings under a workload
+        # that already exceeds every other resource bound; committed
+        # bindings are released eagerly and cost nothing.
+        self._entry_registry = _BoundedDict(ENTRY_REGISTRY_CAP)
         self._inbox: asyncio.Queue = asyncio.Queue(maxsize=65536)
         # The inbox holds RAW frames (parsed in the worker chunk stage),
         # each up to transport MAX_FRAME (16 MiB) — so the entry-count
@@ -422,8 +463,13 @@ class Broadcast:
         self.catchup_handler = None
         # node-service hook fired (once per GC pass) when some slot has
         # been stalled past STALLED_CATCHUP_AFTER: push-retransmission
-        # has failed, recovery belongs to the ledger-catchup plane
+        # has failed, recovery belongs to the ledger-catchup plane.
+        # Kicks are damped with hysteresis (min interval + exponential
+        # backoff, STALL_KICK_*) so a persistent stall cannot storm the
+        # network with catchup sessions every GC pass.
         self.stall_handler = None
+        self._stall_last_kick = float("-inf")
+        self._stall_backoff = STALL_KICK_MIN_INTERVAL
         # observability counters (SURVEY.md §5: per-stage counters)
         self.stats = {
             "gossip_rx": 0,
@@ -440,6 +486,12 @@ class Broadcast:
             "batch_ready_rx": 0,
             "batch_entries_delivered": 0,
             "retransmits": 0,
+            # robustness counters (poison-entry resolution, PR 1):
+            # entries resolved by local rejection when their slot retired,
+            # retired slots, and stall kicks absorbed by the hysteresis
+            "poison_resolved": 0,
+            "slots_retired": 0,
+            "stall_kicks_suppressed": 0,
         }
 
     async def start(self) -> None:
@@ -526,14 +578,21 @@ class Broadcast:
             for slot in list(self._batch_slots):
                 bstate = self._batch_slots[slot]
                 age = now - bstate.created
-                if bstate.delivered_all and age > DELIVERED_RETENTION:
+                if not (bstate.delivered_all or bstate.retired):
+                    # a slot can become retire-eligible between worker
+                    # transitions (e.g. the last quorate entry delivered
+                    # via another content's votes); settle it here so it
+                    # never sits through a pass as a false "stall"
+                    self._maybe_retire_batch(slot, bstate)
+                resolved = bstate.delivered_all or bstate.retired
+                if resolved and age > DELIVERED_RETENTION:
                     self._delivered_batch_slots.add(slot)
                     del self._batch_slots[slot]
                 elif age > SLOT_MAX_AGE:
-                    if not bstate.delivered_all:
+                    if not resolved:
                         self._undelivered -= 1
                     del self._batch_slots[slot]
-                elif not bstate.delivered_all:
+                elif not resolved:
                     # retry the batch pull when quorate entries await content
                     for chash, rv in bstate.ready_votes.items():
                         if chash in bstate.contents:
@@ -547,16 +606,39 @@ class Broadcast:
                         slot, bstate, now
                     ):
                         budget -= 1
-                    if age > STALLED_CATCHUP_AFTER:
+                    # "stalled awaiting quorum" vs "stalled with
+                    # unresolved poison": only the former can be healed
+                    # by the catchup plane (the slot may be committed
+                    # network-wide). A slot whose only undelivered
+                    # entries are ones WE rejected is poison-blocked —
+                    # a network-wide catchup kick cannot resolve it and
+                    # must not be fired for it.
+                    if age > STALLED_CATCHUP_AFTER and not (
+                        self._poison_blocked_only(bstate)
+                    ):
                         stalled_past_horizon = True
             if stalled_past_horizon and self.stall_handler is not None:
                 # beyond push-retransmission: the slot may be committed
                 # network-wide with the helpers' delivered state expiring
-                # — the ledger-catchup plane replays it from history
-                try:
-                    self.stall_handler()
-                except Exception:
-                    logger.exception("stall handler error")
+                # — the ledger-catchup plane replays it from history.
+                # Hysteresis: consecutive kicks are spaced at least
+                # _stall_backoff apart (doubling while the stall
+                # persists) so one misbehaving slot cannot trigger a
+                # catchup session every GC pass network-wide.
+                if now - self._stall_last_kick >= self._stall_backoff:
+                    self._stall_last_kick = now
+                    self._stall_backoff = min(
+                        self._stall_backoff * 2, STALL_KICK_MAX_INTERVAL
+                    )
+                    try:
+                        self.stall_handler()
+                    except Exception:
+                        logger.exception("stall handler error")
+                else:
+                    self.stats["stall_kicks_suppressed"] += 1
+            elif not stalled_past_horizon:
+                # healthy pass: re-arm the hysteresis for the next storm
+                self._stall_backoff = STALL_KICK_MIN_INTERVAL
 
     def _resend_slot(
         self, slot: Slot, state: _SlotState, peer: Optional[Peer]
@@ -1027,6 +1109,18 @@ class Broadcast:
 
     # -- batched plane (module docstring) ---------------------------------
 
+    def release_entry(self, sender: bytes, sequence: int) -> None:
+        """Drop the (sender, seq) -> content equivocation binding once the
+        sequence has passed the LEDGER gate (the service's commit loop
+        calls this). Safe because the per-account sequence gate now
+        rejects ANY content for this sequence — committed or conflicting
+        — so the registry's job for the slot is done. Eager release keeps
+        the registry's working set proportional to in-flight
+        (uncommitted) entries instead of all-time traffic, which is what
+        makes the FIFO cap a dead-man's valve rather than a live
+        eviction path (see the construction-site comment)."""
+        self._entry_registry.pop((sender, sequence))
+
     def _new_or_existing_batch_slot(self, slot) -> _BatchState:
         state = self._batch_slots.get(slot)
         if state is None:
@@ -1101,10 +1195,13 @@ class Broadcast:
             att.signature,
         )
         if seen_key in self._attest_seen:
-            # duplicate on a fully-delivered batch slot: straggler
-            # retransmission beacon — help (see _pre_attestation)
+            # duplicate on a fully-delivered (or retired — resolved is
+            # resolved) batch slot: straggler retransmission beacon —
+            # help (see _pre_attestation)
             dstate = self._batch_slots.get(slot)
-            if dstate is not None and dstate.delivered_all:
+            if dstate is not None and (
+                dstate.delivered_all or dstate.retired
+            ):
                 self._help_batch_straggler(peer, slot, dstate)
             return False
         self._attest_seen.add(seen_key)
@@ -1146,7 +1243,13 @@ class Broadcast:
             self._gossip_seen.discard((BATCH, slot, chash))
             return
         state.contents[chash] = batch
-        state.nbits = max(state.nbits, batch.count)
+        # the real entry count is now known: CLAMP nbits to the widest
+        # known content rather than only growing it — oversized
+        # attestation bitmaps received before any content landed must not
+        # leave phantom entry positions behind (positions >= count can
+        # never deliver, but could spuriously quorate and trigger content
+        # pulls forever — ADVICE.md stack.py:1199)
+        state.nbits = max(b.count for b in state.contents.values())
         # murmur: relay the batch to everyone
         self.mesh.broadcast(batch.encode())
         # sieve, batched: echo only the FIRST batch content for this slot,
@@ -1155,9 +1258,11 @@ class Broadcast:
         if state.echoed_hash is None:
             state.echoed_hash = chash
             bits = 0
+            rejected = 0
             for i, ok in enumerate(entry_oks):
                 if not ok:
                     self.stats["invalid_sig"] += 1
+                    rejected |= 1 << i  # locally RESOLVED: rejected
                     continue
                 entry = batch.entry_bytes(i)
                 ekey = (entry[:32], int.from_bytes(entry[32:36], "little"))
@@ -1165,14 +1270,18 @@ class Broadcast:
                 if bound is None:
                     self._entry_registry.put(ekey, entry)
                 elif bound != entry:
-                    continue  # conflicting content already endorsed
+                    # conflicting content already endorsed: resolved too
+                    rejected |= 1 << i
+                    continue
                 bits |= 1 << i
             state.own_echo_bits[chash] = bits
+            state.rejected_bits[chash] = rejected
             if bits:
                 self._send_batch_attestation(
                     BATCH_ECHO, slot, chash, bits, batch.count
                 )
         self._advance_batch(slot, state, chash)
+        self._maybe_retire_batch(slot, state)
 
     def _post_batch_attestation(self, att: BatchAttestation) -> None:
         slot = (att.batch_origin, att.batch_seq)
@@ -1195,9 +1304,28 @@ class Broadcast:
         if votes is None:
             votes = votes_map[att.batch_hash] = _BatchVotes()
         nbits = len(att.bitmap) * 8
-        if votes.add(att.origin, int.from_bytes(att.bitmap, "little"), nbits):
+        bits = int.from_bytes(att.bitmap, "little")
+        if state.contents:
+            # Clamp the claimed width to the batch's REAL entry count once
+            # any slot content is known: bits at positions >= count are
+            # phantom — they can never deliver, and without the clamp they
+            # inflate state.nbits and the vote counts, spuriously quorate,
+            # and drive pointless content pulls (ADVICE.md stack.py:1199).
+            known = state.contents.get(att.batch_hash)
+            count = (
+                known.count
+                if known is not None
+                else max(b.count for b in state.contents.values())
+            )
+            if nbits > count:
+                nbits = count
+                bits &= (1 << count) - 1
+                if not bits:
+                    return
+        if votes.add(att.origin, bits, nbits):
             state.nbits = max(state.nbits, nbits)
             self._advance_batch(slot, state, att.batch_hash)
+            self._maybe_retire_batch(slot, state)
 
     def _send_batch_attestation(
         self,
@@ -1296,8 +1424,104 @@ class Broadcast:
         if state.delivered_bits[chash] == (1 << batch.count) - 1:
             if not state.delivered_all:
                 state.delivered_all = True
-                self._undelivered -= 1
+                # a retired slot already left the undelivered population
+                if not state.retired:
+                    self._undelivered -= 1
                 self.stats["delivered"] += 1
+
+    def _ready_quorate_bits(
+        self, state: _BatchState, chash: bytes, nbits: int
+    ) -> int:
+        """Entries of ``chash`` holding a full Ready quorum — the
+        deliverable set, mirroring _advance_batch's degenerate-threshold
+        handling (thresholds <= 0 fall back to echo quorum / own bits)."""
+        if self.ready_threshold <= 0:
+            if self.echo_threshold <= 0:
+                return state.own_echo_bits.get(chash, 0)
+            ev = state.echo_votes.get(chash)
+            return _quorate_mask(
+                ev.counts if ev is not None else _EMPTY_COUNTS,
+                self.echo_threshold,
+                nbits,
+            )
+        rv = state.ready_votes.get(chash)
+        return _quorate_mask(
+            rv.counts if rv is not None else _EMPTY_COUNTS,
+            self.ready_threshold,
+            nbits,
+        )
+
+    def _maybe_retire_batch(self, slot, state: _BatchState) -> None:
+        """Retire a batch slot that is complete-by-RESOLUTION: every
+        ready-quorate entry is delivered and every remaining entry of the
+        echoed content is locally resolved-rejected (invalid client
+        signature or equivocation-registry conflict at echo time).
+
+        Without retirement, a single never-deliverable poison entry held
+        the slot "stalled" for SLOT_MAX_AGE — burning retransmission
+        budget and firing network-wide stall kicks every GC pass (the
+        byzantine amplification in ADVICE.md stack.py:1296). A retired
+        slot leaves the undelivered population immediately and compacts
+        after DELIVERED_RETENTION like a delivered one. Retirement does
+        NOT gate delivery: while the slot is retained, a late Ready
+        quorum for a rejected entry still delivers it through
+        _advance_batch (our local rejection is not the network's
+        verdict); after compaction, recovery belongs to the ledger
+        catchup plane — the same contract as any expired slot."""
+        if state.delivered_all or state.retired:
+            return
+        chash = state.echoed_hash
+        if chash is None:
+            return  # no content echoed yet: nothing is resolved
+        batch = state.contents.get(chash)
+        if batch is None:
+            return
+        full = (1 << batch.count) - 1
+        delivered = state.delivered_bits.get(chash, 0)
+        rejected = state.rejected_bits.get(chash, 0)
+        if (delivered | rejected) & full != full:
+            return  # unresolved entries remain: genuinely in progress
+        # every ready-quorate entry — on ANY content with votes, not just
+        # the echoed one (an equivocating origin's sibling content could
+        # quorate if enough peers echoed it first) — must be delivered
+        for h in set(state.ready_votes) | {chash}:
+            b = state.contents.get(h)
+            nb = b.count if b is not None else state.nbits
+            if self._ready_quorate_bits(
+                state, h, nb
+            ) & ~state.delivered_bits.get(h, 0):
+                return
+        state.retired = True
+        self._undelivered -= 1
+        self.stats["slots_retired"] += 1
+        poison = rejected & ~delivered
+        self.stats["poison_resolved"] += poison.bit_count()
+
+    def _poison_blocked_only(self, state: _BatchState) -> bool:
+        """True when every undelivered entry is one this node rejected at
+        echo time and nothing quorate is missing: the network never
+        endorsed the poison, so a catchup session cannot heal the slot
+        and the stall signal must not fire for it. (Such a slot is
+        normally retired by _maybe_retire_batch; this guards the GC's
+        stall classification in the window before retirement settles.)"""
+        chash = state.echoed_hash
+        if chash is None:
+            return False
+        batch = state.contents.get(chash)
+        if batch is None:
+            return False
+        full = (1 << batch.count) - 1
+        undelivered = full & ~state.delivered_bits.get(chash, 0)
+        if undelivered & ~state.rejected_bits.get(chash, 0):
+            return False  # an unresolved entry genuinely awaits quorum
+        for h in set(state.ready_votes) | {chash}:
+            b = state.contents.get(h)
+            nb = b.count if b is not None else state.nbits
+            if self._ready_quorate_bits(
+                state, h, nb
+            ) & ~state.delivered_bits.get(h, 0):
+                return False
+        return True
 
     def _on_batch_request(
         self, peer: Optional[Peer], req: BatchContentRequest

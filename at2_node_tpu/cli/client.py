@@ -20,7 +20,11 @@ from __future__ import annotations
 import argparse
 import asyncio
 import sys
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is the same parser
+    import tomli as tomllib
 from dataclasses import dataclass
 
 from ..client import Client

@@ -6,24 +6,34 @@ PrivateKey}` (used at `/root/reference/src/lib.rs:5`,
 `drop::crypto::key::exchange::KeyPair` (used at
 `/root/reference/src/bin/server/rpc.rs:14-17,80`).
 
-Host-side single signatures use the `cryptography` library (OpenSSL);
-the batched hot path lives on TPU (`at2_node_tpu.ops.ed25519`). Keys are
-hex-encoded in config files, matching the reference's `#[serde(with =
-"hex")]` (`/root/reference/src/bin/server/config.rs:14-17`).
+Host-side single signatures use the `cryptography` library (OpenSSL)
+when the wheel is present, else the pure-Python RFC implementations in
+`crypto/_fallback.py` (same algorithms, wire-compatible); the batched
+hot path lives on TPU (`at2_node_tpu.ops.ed25519`). Keys are hex-encoded
+in config files, matching the reference's `#[serde(with = "hex")]`
+(`/root/reference/src/bin/server/config.rs:14-17`).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ed25519, x25519
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519, x25519
 
-_RAW = serialization.Encoding.Raw
-_RAW_PUB = serialization.PublicFormat.Raw
-_RAW_PRIV = serialization.PrivateFormat.Raw
-_NOENC = serialization.NoEncryption()
+    _HAVE_OPENSSL = True
+    _RAW = serialization.Encoding.Raw
+    _RAW_PUB = serialization.PublicFormat.Raw
+    _RAW_PRIV = serialization.PrivateFormat.Raw
+    _NOENC = serialization.NoEncryption()
+except ImportError:  # image without the OpenSSL wheels: RFC fallback
+    from ._fallback import InvalidSignature  # noqa: F401 (re-exported)
+
+    _HAVE_OPENSSL = False
+
+from . import _fallback as _fb
 
 
 @dataclass(frozen=True)
@@ -40,6 +50,8 @@ class SignKeyPair:
 
     @staticmethod
     def random() -> "SignKeyPair":
+        if not _HAVE_OPENSSL:
+            return SignKeyPair(_fb.ed25519_generate_seed())
         key = ed25519.Ed25519PrivateKey.generate()
         return SignKeyPair(key.private_bytes(_RAW, _RAW_PRIV, _NOENC))
 
@@ -50,7 +62,7 @@ class SignKeyPair:
     def to_hex(self) -> str:
         return self.private_bytes.hex()
 
-    def _key(self) -> ed25519.Ed25519PrivateKey:
+    def _key(self) -> "ed25519.Ed25519PrivateKey":
         cached = self.__dict__.get("_key_obj")
         if cached is None:
             cached = ed25519.Ed25519PrivateKey.from_private_bytes(
@@ -63,11 +75,16 @@ class SignKeyPair:
     def public(self) -> bytes:
         cached = self.__dict__.get("_pub")
         if cached is None:
-            cached = self._key().public_key().public_bytes(_RAW, _RAW_PUB)
+            if _HAVE_OPENSSL:
+                cached = self._key().public_key().public_bytes(_RAW, _RAW_PUB)
+            else:
+                cached = _fb.ed25519_public(self.private_bytes)
             object.__setattr__(self, "_pub", cached)
         return cached
 
     def sign(self, message: bytes) -> bytes:
+        if not _HAVE_OPENSSL:
+            return _fb.ed25519_sign(self.private_bytes, message)
         return self._key().sign(message)
 
 
@@ -75,9 +92,12 @@ def verify_one(public_key: bytes, message: bytes, signature: bytes) -> bool:
     """Single CPU ed25519 verification (the reference's per-message path;
     the TPU batch path is `ops.ed25519.verify_batch`)."""
     try:
-        ed25519.Ed25519PublicKey.from_public_bytes(public_key).verify(
-            signature, message
-        )
+        if _HAVE_OPENSSL:
+            ed25519.Ed25519PublicKey.from_public_bytes(public_key).verify(
+                signature, message
+            )
+        else:
+            _fb.ed25519_verify(public_key, message, signature)
         return True
     except (InvalidSignature, ValueError):
         return False
@@ -92,6 +112,8 @@ class ExchangeKeyPair:
 
     @staticmethod
     def random() -> "ExchangeKeyPair":
+        if not _HAVE_OPENSSL:
+            return ExchangeKeyPair(_fb.x25519_generate_seed())
         key = x25519.X25519PrivateKey.generate()
         return ExchangeKeyPair(key.private_bytes(_RAW, _RAW_PRIV, _NOENC))
 
@@ -104,9 +126,13 @@ class ExchangeKeyPair:
 
     @property
     def public(self) -> bytes:
+        if not _HAVE_OPENSSL:
+            return _fb.x25519_public(self.private_bytes)
         key = x25519.X25519PrivateKey.from_private_bytes(self.private_bytes)
         return key.public_key().public_bytes(_RAW, _RAW_PUB)
 
     def exchange(self, peer_public: bytes) -> bytes:
+        if not _HAVE_OPENSSL:
+            return _fb.x25519(self.private_bytes, peer_public)
         key = x25519.X25519PrivateKey.from_private_bytes(self.private_bytes)
         return key.exchange(x25519.X25519PublicKey.from_public_bytes(peer_public))

@@ -36,10 +36,24 @@ import os
 import struct
 from dataclasses import dataclass, field
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    def _hkdf32(shared: bytes, salt: bytes, info: bytes) -> bytes:
+        return HKDF(
+            algorithm=hashes.SHA256(), length=32, salt=salt, info=info
+        ).derive(shared)
+
+except ImportError:  # image without the OpenSSL wheels: RFC fallback
+    from ..crypto._fallback import ChaCha20Poly1305, InvalidTag
+
+    def _hkdf32(shared: bytes, salt: bytes, info: bytes) -> bytes:
+        from ..crypto._fallback import hkdf_sha256
+
+        return hkdf_sha256(shared, salt, info, 32)
 
 from ..crypto.keys import ExchangeKeyPair
 
@@ -68,12 +82,11 @@ def _derive(
     per-connection nonces make the keys unique per connection."""
 
     def one(direction: bytes) -> bytes:
-        return HKDF(
-            algorithm=hashes.SHA256(),
-            length=32,
-            salt=initiator_pub + responder_pub + initiator_nonce + responder_nonce,
-            info=b"at2-node-tpu channel " + direction,
-        ).derive(shared)
+        return _hkdf32(
+            shared,
+            initiator_pub + responder_pub + initiator_nonce + responder_nonce,
+            b"at2-node-tpu channel " + direction,
+        )
 
     return one(b"i2r"), one(b"r2i")
 

@@ -91,7 +91,14 @@ class _Abort(Exception):
 
 class _WebContext:
     """Minimal stand-in for grpc.aio.ServicerContext under grpc-web: the
-    servicer methods only use ``abort`` (see node/service.py handlers)."""
+    servicer methods use ``abort`` and ``peer`` (see node/service.py
+    handlers — ``peer`` keys the per-source admission token bucket)."""
+
+    def __init__(self, peer: str = "web:unknown") -> None:
+        self._peer = peer
+
+    def peer(self) -> str:
+        return self._peer
 
     async def abort(self, code: grpc.StatusCode, details: str = "") -> None:
         raise _Abort(code, details)
@@ -466,7 +473,15 @@ class PortMux:
                 await self._respond(writer, "400 Bad Request", "text/plain", b"")
                 return False
 
-        status, message, reply_bytes = await self._dispatch(path, body)
+        # key admission buckets by HOST only: HTTP/1 connections churn
+        # ephemeral ports, and a per-port bucket would reset on reconnect
+        peername = writer.get_extra_info("peername")
+        peer = (
+            f"web:{peername[0]}"
+            if isinstance(peername, tuple) and peername
+            else "web:unknown"
+        )
+        status, message, reply_bytes = await self._dispatch(path, body, peer)
 
         payload = b""
         if reply_bytes is not None:
@@ -484,7 +499,7 @@ class PortMux:
         return keep
 
     async def _dispatch(
-        self, path: str, body: bytes
+        self, path: str, body: bytes, peer: str = "web:unknown"
     ) -> Tuple[int, str, Optional[bytes]]:
         """Decode the request, run the servicer method, encode the reply.
         Returns (grpc-status, grpc-message, reply bytes or None)."""
@@ -507,7 +522,7 @@ class PortMux:
                 None,
             )
         try:
-            reply = await handler(request, _WebContext())
+            reply = await handler(request, _WebContext(peer))
         except _Abort as abort:
             return _status_int(abort.code), abort.details, None
         except Exception:

@@ -30,12 +30,20 @@ Two conscious additions over the reference schema:
 * an optional `[batching]` table — `enabled`, `max_entries`, `window`
   (see `BatchingConfig`) — ingress transaction batching over the batched
   broadcast plane (broadcast/stack.py); `enabled = false` restores the
-  reference's one-transaction-per-broadcast-slot behavior exactly.
+  reference's one-transaction-per-broadcast-slot behavior exactly;
+* an optional `[admission]` table — `preverify`, `fail_limit`,
+  `fail_window` (see `AdmissionConfig`) — ingress pre-verification of
+  client signatures at the RPC boundary plus a per-source rate limit on
+  entries that FAIL it; `preverify = false` restores the previous
+  admit-then-verify-in-broadcast behavior exactly.
 """
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is the same parser
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import List, Optional, TextIO
 
@@ -126,6 +134,36 @@ class BatchingConfig:
 
 
 @dataclass
+class AdmissionConfig:
+    """Ingress admission control (node/service.py SendAsset /
+    SendAssetBatch). With ``preverify`` on, every admission batch runs
+    its client signatures through ONE ``Verifier.verify_many`` call (the
+    same CPU/TPU seam the broadcast plane uses) and entries that fail
+    are rejected at the RPC boundary with a per-entry status —
+    unauthenticated spam never enters the gossip plane at all.
+    ``fail_limit`` / ``fail_window`` shape a per-source token bucket
+    charged ONLY for entries that fail pre-verification, so a hostile
+    client cannot use the verifier itself as a DoS lever: up to
+    ``fail_limit`` failed entries per source are tolerated per bucket,
+    refilling continuously over ``fail_window`` seconds; beyond that the
+    source's requests are rejected outright (RESOURCE_EXHAUSTED) without
+    spending any verifier throughput. Honest clients never pay: valid
+    entries cost zero tokens. ``preverify = false`` restores the
+    previous behavior (admit everything, verification happens inside the
+    broadcast workers)."""
+
+    preverify: bool = True
+    fail_limit: int = 64
+    fail_window: float = 10.0
+
+    def __post_init__(self) -> None:
+        if self.fail_limit < 1:
+            raise ValueError("admission.fail_limit must be >= 1")
+        if self.fail_window <= 0:
+            raise ValueError("admission.fail_window must be > 0")
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -139,6 +177,7 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     catchup: CatchupConfig = field(default_factory=CatchupConfig)
     batching: BatchingConfig = field(default_factory=BatchingConfig)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -200,6 +239,15 @@ class Config:
                 f"max_entries = {ba.max_entries}",
                 f"window = {ba.window}",
             ]
+        ad = self.admission
+        if ad != AdmissionConfig():
+            lines += [
+                "",
+                "[admission]",
+                f"preverify = {'true' if ad.preverify else 'false'}",
+                f"fail_limit = {ad.fail_limit}",
+                f"fail_window = {ad.fail_window}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -218,6 +266,7 @@ class Config:
         ckpt = CheckpointConfig(**doc.get("checkpoint", {}))
         catchup = CatchupConfig(**doc.get("catchup", {}))
         batching = BatchingConfig(**doc.get("batching", {}))
+        admission = AdmissionConfig(**doc.get("admission", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -236,6 +285,7 @@ class Config:
             checkpoint=ckpt,
             catchup=catchup,
             batching=batching,
+            admission=admission,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

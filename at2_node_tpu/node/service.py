@@ -80,6 +80,11 @@ MAX_SESSION_PAYLOADS = 1 << 17
 SERVE_IDX_PER_SEC = 4
 SERVE_ROWS_PER_SEC = 4 * 4096
 
+# Distinct ingress sources tracked by the admission rate limiter (one
+# token bucket per gRPC peer string). A source evicted at the cap simply
+# starts a fresh, full bucket — the cap bounds memory, not correctness.
+ADMISSION_SOURCES_CAP = 4096
+
 
 class _CatchupSession:
     """In-flight catchup state: peers' frontiers and served payloads,
@@ -178,6 +183,14 @@ class Service(At2Servicer):
         self._batch_buf: List[Payload] = []
         self._batch_flush_task: Optional[asyncio.Task] = None
         self._batch_seq = int(time.time() * 1000) << 20
+        # ingress admission (config [admission]): per-source token
+        # buckets charged ONLY for entries that fail pre-verification —
+        # source -> [tokens, refill_stamp]
+        self._admission_buckets: Dict[str, list] = {}
+        self.admission_stats = {
+            "rejected_at_ingress": 0,
+            "admission_throttled": 0,
+        }
 
     # -- lifecycle --------------------------------------------------------
 
@@ -409,6 +422,7 @@ class Service(At2Servicer):
         verifier batch metrics + commit progress (SURVEY.md §5)."""
         out = {"committed": self.committed, "pending": len(self._heap)}
         out.update(self.catchup_stats)
+        out.update(self.admission_stats)
         out["history_retained"] = len(self.history)
         if self.broadcast is not None:
             out.update(self.broadcast.stats)
@@ -592,19 +606,15 @@ class Service(At2Servicer):
             retry, ring_ops, commits = await self.accounts.run_exclusive(
                 _apply_pass
             )
-            for key, payload in commits:
-                logger.info(
-                    "new payload: seq=%d sender=%s",
-                    payload.sequence,
-                    payload.sender.hex()[:16],
-                )
-                self.committed += 1
-                if key in self._catchup_keys:
-                    self._catchup_commits += 1
-                # retain for peers' ledger catchup (ledger/history.py)
-                self.history.record(payload)
-            if ring_ops:
-                await self.recent.apply_many(ring_ops)
+            if commits or ring_ops:
+                # the accounts mutation already happened inside
+                # run_exclusive: a cancellation landing between it and the
+                # history/ring bookkeeping would leave committed transfers
+                # invisible to catchup peers and stuck Pending in the
+                # recent ring. Shield the tail so close()'s task
+                # cancellation can interrupt the DRAIN but never split a
+                # commit from its record.
+                await asyncio.shield(self._commit_tail(commits, ring_ops))
             # merge the leftovers with anything that arrived mid-pass; no
             # awaits between here and the key rebuild, so the set and the
             # heap cannot diverge
@@ -634,6 +644,30 @@ class Service(At2Servicer):
             and self.mesh.peers
         ):
             self._kick_catchup()
+
+    async def _commit_tail(self, commits: list, ring_ops: list) -> None:
+        """Post-apply commit bookkeeping, always run to completion (the
+        caller shields it): history retention, counters, equivocation-
+        registry release, and the recent-ring flips."""
+        for key, payload in commits:
+            logger.info(
+                "new payload: seq=%d sender=%s",
+                payload.sequence,
+                payload.sender.hex()[:16],
+            )
+            self.committed += 1
+            if key in self._catchup_keys:
+                self._catchup_commits += 1
+            # retain for peers' ledger catchup (ledger/history.py)
+            self.history.record(payload)
+            if self.broadcast is not None:
+                # the ledger's per-client sequence gate now owns this
+                # (sender, sequence) binding — release the broadcast
+                # plane's equivocation-registry entry eagerly so the
+                # registry's working set tracks in-flight entries only
+                self.broadcast.release_entry(payload.sender, payload.sequence)
+        if ring_ops:
+            await self.recent.apply_many(ring_ops)
 
     # -- ledger-history catchup ------------------------------------------
     #
@@ -972,16 +1006,89 @@ class Service(At2Servicer):
                 self._delayed_flush(bcfg.window)
             )
 
+    # -- ingress admission (config [admission]) --------------------------
+
+    def _admission_refill(self, source: str, now: float) -> list:
+        """The source's token bucket ``[tokens, stamp]``, refilled
+        continuously to ``fail_limit`` over ``fail_window`` seconds."""
+        ad = self.config.admission
+        rate = ad.fail_limit / ad.fail_window
+        bucket = self._admission_buckets.get(source)
+        if bucket is None:
+            if len(self._admission_buckets) >= ADMISSION_SOURCES_CAP:
+                # evict fully-refilled buckets first (they carry no
+                # throttling state); if every source is actively failing,
+                # drop the oldest — it restarts with a full bucket
+                full = [
+                    k
+                    for k, (t, s) in self._admission_buckets.items()
+                    if t + (now - s) * rate >= ad.fail_limit
+                ]
+                for k in full:
+                    del self._admission_buckets[k]
+                if len(self._admission_buckets) >= ADMISSION_SOURCES_CAP:
+                    self._admission_buckets.pop(
+                        next(iter(self._admission_buckets))
+                    )
+            bucket = [float(ad.fail_limit), now]
+            self._admission_buckets[source] = bucket
+        else:
+            bucket[0] = min(
+                float(ad.fail_limit), bucket[0] + (now - bucket[1]) * rate
+            )
+            bucket[1] = now
+        return bucket
+
+    async def _admit(self, payloads: List[Payload], context) -> None:
+        """Pre-verify client signatures at the RPC boundary: ONE
+        ``Verifier.verify_many`` call per admission batch (the same
+        CPU/TPU seam the broadcast workers use). Entries failing it are
+        rejected HERE — they never reach the gossip plane, so one
+        poisoned entry can no longer stall a whole broadcast slot. The
+        per-source bucket is charged only for FAILED entries; a source
+        that exhausted it is refused before any verifier work."""
+        ad = self.config.admission
+        if not ad.preverify or self.verifier is None:
+            return
+        peer_fn = getattr(context, "peer", None)
+        source = peer_fn() if callable(peer_fn) else "local"
+        bucket = self._admission_refill(source, time.monotonic())
+        if bucket[0] < 1.0:
+            self.admission_stats["admission_throttled"] += 1
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many invalid signatures from this source; retry later",
+            )
+        results = await self.verifier.verify_many(
+            [
+                (p.sender, p.transaction.signing_bytes(), p.signature)
+                for p in payloads
+            ]
+        )
+        bad = [i for i, ok in enumerate(results) if not ok]
+        if not bad:
+            return
+        self.admission_stats["rejected_at_ingress"] += len(bad)
+        bucket[0] = max(0.0, bucket[0] - len(bad))
+        await context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT,
+            "client signature verification failed"
+            + (f" (entries {bad})" if len(payloads) > 1 else ""),
+        )
+
     async def SendAsset(self, request, context):
         payload = await self._validated_payload(request, context)
+        await self._admit([payload], context)
         await self._ingest([payload])
         return pb.SendAssetReply()
 
     async def SendAssetBatch(self, request, context):
         """Beyond-parity bulk ingress (at2.proto documents the contract):
         semantically identical to one SendAsset per entry, one RPC
-        round-trip. The whole request is validated before any entry is
-        admitted (all-or-nothing admission; commit outcomes stay
+        round-trip. The whole request is validated — shape first, then
+        client signatures via ingress pre-verification (config
+        [admission]) — before any entry is admitted (all-or-nothing
+        admission with per-entry rejection detail; commit outcomes stay
         per-entry, exactly like separate SendAssets)."""
         if not request.transactions:
             await context.abort(
@@ -997,6 +1104,7 @@ class Service(At2Servicer):
             payloads.append(
                 await self._validated_payload(req, context, f" (entry {i})")
             )
+        await self._admit(payloads, context)
         await self._ingest(payloads)
         return pb.SendAssetReply()
 

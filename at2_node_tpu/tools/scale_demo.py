@@ -163,7 +163,13 @@ def main(argv=None) -> int:
 
     if args.virtual_mesh:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.virtual_mesh)
+        try:
+            jax.config.update("jax_num_cpu_devices", args.virtual_mesh)
+        except AttributeError:  # older jax: the lazy backend honors XLA_FLAGS
+            _os.environ["XLA_FLAGS"] = (
+                _os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.virtual_mesh}"
+            ).strip()
     # Persistent compile cache (same dir as the test tier): the pool's
     # sharded graphs take minutes of XLA-CPU compile on one core — paying
     # that once per SHAPE ever, not once per run, is what makes this demo

@@ -34,6 +34,14 @@ EOF
 echo "== fast tier =="
 python -m pytest tests/ -q -m "not slow"
 
+echo "== poison-slot chaos gate =="
+# Byzantine amplification regression (ISSUE 1): a bad-sig entry per
+# ingress batch must not stall slots, fire stall kicks, or trigger
+# catchup. Named explicitly so a marker/collection change in the fast
+# tier can never silently drop it.
+python -m pytest tests/test_faults.py::TestPoisonChaos \
+    tests/test_poison_resolution.py -q
+
 if [ "$tier" = "all" ]; then
   echo "== native sanitizers (TSAN + ASAN) =="
   # the reference gets race-freedom from Rust; the C++ prep library gets

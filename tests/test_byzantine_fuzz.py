@@ -76,6 +76,7 @@ class _HostileFuzzer:
         # identities this fuzzer signs client payloads with
         self.clients = [SignKeyPair.random() for _ in range(3)]
         self.recipients = [SignKeyPair.random().public for _ in range(3)]
+        self.batches = []  # real TxBatches sent: targets for oversized bitmaps
 
     async def dial(self, cfgs):
         for i, cfg in enumerate(cfgs):
@@ -115,7 +116,59 @@ class _HostileFuzzer:
             self._rand_payload().encode()[1:]
             for _ in range(rng.randint(1, 6))
         )
-        return TxBatch.create(self.sign, rng.randint(1, 5), entries)
+        batch = TxBatch.create(self.sign, rng.randint(1, 5), entries)
+        self.batches.append(batch)
+        return batch
+
+    def _poison_batch(self):
+        """A batch GUARANTEED to carry at least one never-verifiable
+        entry among honest-looking ones — the poison-slot resolution
+        path's bread and butter (slot must retire, never stall)."""
+        rng = self.rng
+        payloads = [self._rand_payload() for _ in range(rng.randint(1, 4))]
+        payloads.insert(
+            rng.randrange(len(payloads) + 1),
+            self._payload(
+                rng.choice(self.clients),
+                rng.randint(1, 4),
+                rng.choice(self.recipients),
+                rng.randint(1, 50),
+                good_sig=False,
+            ),
+        )
+        entries = b"".join(p.encode()[1:] for p in payloads)
+        batch = TxBatch.create(self.sign, rng.randint(1, 5), entries)
+        self.batches.append(batch)
+        return batch
+
+    def _oversized_batch_attestation(self):
+        """A correctly signed attestation for a REAL previously-sent
+        batch whose bitmap claims far more entries than the batch has:
+        exercises the width clamp (phantom bits must not grow nbits or
+        spuriously quorate). Falls back to a random one before any batch
+        exists."""
+        rng = self.rng
+        if not self.batches:
+            return self._rand_batch_attestation()
+        batch = rng.choice(self.batches)
+        phase = rng.choice((BATCH_ECHO, BATCH_READY))
+        bitmap = bytes(
+            rng.getrandbits(8) | 1 for _ in range(rng.choice((16, 64, 128)))
+        )
+        sig = self.sign.sign(
+            BatchAttestation.signing_bytes(
+                phase, batch.origin, batch.batch_seq, batch.content_hash(), bitmap
+            )
+        )
+        return BatchAttestation(
+            phase,
+            self.sign.public,
+            batch.origin,
+            batch.batch_seq,
+            batch.content_hash(),
+            bitmap,
+            sig,
+        )
 
     def _rand_attestation(self):
         rng = self.rng
@@ -191,18 +244,22 @@ class _HostileFuzzer:
     def next_frame(self) -> bytes:
         rng = self.rng
         roll = rng.random()
-        if roll < 0.25:
+        if roll < 0.22:
             msgs = [self._rand_payload() for _ in range(rng.randint(1, 3))]
             frame = b"".join(m.encode() for m in msgs)
-        elif roll < 0.40:
+        elif roll < 0.34:
             frame = self._rand_batch().encode()
-        elif roll < 0.60:
+        elif roll < 0.42:
+            frame = self._poison_batch().encode()
+        elif roll < 0.58:
             frame = self._rand_attestation().encode()
-        elif roll < 0.72:
+        elif roll < 0.68:
             frame = self._rand_batch_attestation().encode()
-        elif roll < 0.82:
+        elif roll < 0.75:
+            frame = self._oversized_batch_attestation().encode()
+        elif roll < 0.84:
             frame = self._rand_catchup_junk().encode()
-        elif roll < 0.92 and self.sent_log:
+        elif roll < 0.93 and self.sent_log:
             frame = rng.choice(self.sent_log)  # verbatim replay
         else:
             frame = self._malformed()

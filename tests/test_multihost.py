@@ -50,7 +50,11 @@ import os, sys
 sys.path.insert(0, @REPO@)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # older jax: backend is lazy, XLA_FLAGS still works
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
 os.environ["AT2_COORDINATOR"] = "127.0.0.1:@PORT@"
 os.environ["AT2_NUM_PROCESSES"] = "1"
 os.environ["AT2_PROCESS_ID"] = "0"
@@ -89,7 +93,11 @@ sys.path.insert(0, @REPO@)
 pid = int(sys.argv[1])
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # older jax: backend is lazy, XLA_FLAGS still works
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
 os.environ["AT2_COORDINATOR"] = "127.0.0.1:@PORT@"
 os.environ["AT2_NUM_PROCESSES"] = "2"
 os.environ["AT2_PROCESS_ID"] = str(pid)
@@ -139,6 +147,11 @@ def _run_two_procs(body: str, port: int, timeout: float):
             if p.poll() is None:
                 p.kill()
     for rc, out, err in outs:
+        if rc != 0 and "aren't implemented on the CPU backend" in err:
+            # older jaxlib (<= 0.4.x): the CPU backend has no multiprocess
+            # collectives at all — an environment capability gap, not a
+            # regression in the code under test
+            pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
         assert rc == 0, err[-2000:]
     return outs
 

@@ -31,8 +31,15 @@ import json
 import os
 import re
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ed25519
+import pytest
+
+# this module mimics the browser's WebCrypto key handling (PKCS8/SPKI DER),
+# which the pure-python fallback deliberately does not implement
+cryptography = pytest.importorskip(
+    "cryptography", reason="wallet test needs the real cryptography wheel"
+)
+from cryptography.hazmat.primitives import serialization  # noqa: E402
+from cryptography.hazmat.primitives.asymmetric import ed25519  # noqa: E402
 
 from at2_node_tpu.crypto.keys import SignKeyPair
 from at2_node_tpu.proto import at2_pb2 as pb
