@@ -194,13 +194,19 @@ class TpuBatchVerifier:
         batch_size: int = 256,
         max_delay: float = 0.002,
         buckets: Sequence[int] | None = None,
+        max_queue: int | None = None,
     ) -> None:
         self.batch_size = batch_size
         self.max_delay = max_delay
         if buckets is None:
             # One bucket == one compiled program: a flush never exceeds
             # batch_size, so padding to it keeps every dispatch the same
-            # shape and warmup() covers all compilation up front.
+            # shape and warmup() covers all compilation up front. Pass an
+            # explicit bucket ladder (e.g. ops.ed25519.BUCKETS) to enable
+            # ADAPTIVE shaping: timer flushes land in the smallest bucket
+            # that fits instead of padding to batch_size, and a deep
+            # backlog coalesces into the largest bucket the queue can
+            # fill instead of paying per-batch_size dispatch overhead.
             buckets = ()
         self.buckets = tuple(sorted(set(buckets) | {batch_size}))
         self._queue: List[_Pending] = []
@@ -210,7 +216,9 @@ class TpuBatchVerifier:
         # Capacity is a counted reservation (condition variable, bulk
         # acquire/release) so verify_many reserves a whole chunk in one
         # await instead of one semaphore acquire per signature.
-        self.max_queue = max(8 * batch_size, 4096)
+        self.max_queue = (
+            max_queue if max_queue is not None else max(8 * batch_size, 4096)
+        )
         self._cap_free = self.max_queue
         self._cap_cond = asyncio.Condition()
         self._wakeup = asyncio.Event()
@@ -229,6 +237,10 @@ class TpuBatchVerifier:
         self.total_padding = 0
         self.total_dispatch_s = 0.0
         self.last_dispatch_s = 0.0
+        self.total_prep_s = 0.0
+        self.total_launch_s = 0.0
+        self.total_finish_s = 0.0
+        self.queue_peak = 0
 
     def stats(self) -> dict:
         """Operator-facing counters: batch occupancy, padding ratio, and
@@ -239,6 +251,9 @@ class TpuBatchVerifier:
             "batches": n_b,
             "signatures": n_s,
             "queue_depth": len(self._queue),
+            "queue_peak": self.queue_peak,
+            "max_queue": self.max_queue,
+            "capacity_free": self._cap_free,
             "batch_occupancy": (n_s / (n_s + self.total_padding))
             if n_s + self.total_padding
             else 0.0,
@@ -249,6 +264,12 @@ class TpuBatchVerifier:
             # across batches, so this is NOT additive with throughput)
             "avg_dispatch_ms": (1e3 * self.total_dispatch_s / n_b) if n_b else 0.0,
             "last_dispatch_ms": 1e3 * self.last_dispatch_s,
+            # per-stage means: where a batch's wall time actually goes
+            # (prep/launch include their executor-queue wait, so a
+            # saturated stage shows up here as inflation)
+            "prep_ms_avg": (1e3 * self.total_prep_s / n_b) if n_b else 0.0,
+            "launch_ms_avg": (1e3 * self.total_launch_s / n_b) if n_b else 0.0,
+            "finish_ms_avg": (1e3 * self.total_finish_s / n_b) if n_b else 0.0,
         }
 
     def _bucket_for(self, n: int) -> int:
@@ -257,11 +278,33 @@ class TpuBatchVerifier:
                 return b
         return self.buckets[-1]
 
+    def _take_for_flush(self) -> int:
+        """Adaptive dispatch sizing from LIVE queue depth: normally one
+        batch_size slice, but a backlog deeper than batch_size coalesces
+        into the largest configured bucket it can FILL — one 4096-lane
+        dispatch instead of sixteen 256s amortizes the fixed per-dispatch
+        tunnel sync ~16x (bench.py's transfer analysis). Single-bucket
+        verifiers degrade to the old fixed-slice behavior exactly."""
+        depth = len(self._queue)
+        take = self.batch_size
+        for b in self.buckets:
+            if b <= depth:
+                take = max(take, b)
+        return take
+
     async def _acquire(self, n: int) -> None:
         """Reserve queue room for ``n`` signatures in one await."""
         async with self._cap_cond:
             while self._cap_free < n and not self._closed:
-                await self._cap_cond.wait()
+                try:
+                    await self._cap_cond.wait()
+                except asyncio.CancelledError:
+                    # a cancelled waiter may have CONSUMED a notify meant
+                    # for a sibling; pass it on before unwinding or that
+                    # sibling parks forever on free capacity (classic
+                    # Condition lost-wakeup)
+                    self._cap_cond.notify_all()
+                    raise
             if self._closed:
                 raise RuntimeError("verifier closed")
             self._cap_free -= n
@@ -277,10 +320,32 @@ class TpuBatchVerifier:
         append = self._queue.append
         for idx, (pk, msg, sig) in enumerate(items):
             append(_Pending(pk, msg, sig, sink, idx, now))
+        if len(self._queue) > self.queue_peak:
+            self.queue_peak = len(self._queue)
         # Wake the flusher on the empty->non-empty transition too, so a lone
         # request waits max_delay, not the flusher's 100ms idle-poll tick.
         if was_empty or len(self._queue) >= self.batch_size:
             self._wakeup.set()
+
+    async def _evict_sinks(self, sinks: set) -> None:
+        """Pull a cancelled caller's not-yet-dispatched entries back out of
+        the accumulator and return their reserved capacity. Entries already
+        popped by the flusher are past the point of no return (the device
+        is working on them); they resolve or fail through _complete."""
+        kept: List[_Pending] = []
+        evicted = 0
+        for p in self._queue:
+            if p.sink in sinks:
+                evicted += 1
+            else:
+                kept.append(p)
+        self._queue = kept
+        for sink in sinks:
+            sink.fail(RuntimeError("verify cancelled"))
+        if evicted:
+            # shielded: this runs inside cancellation unwinding and MUST
+            # complete, or the cancelled caller's capacity leaks forever
+            await asyncio.shield(self._release(evicted))
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         if self._closed:
@@ -326,7 +391,15 @@ class TpuBatchVerifier:
         # gather (not sequential awaits): when an early chunk's dispatch
         # fails, every sink's exception is still retrieved — no
         # "exception was never retrieved" spam for the later chunks
-        chunk_results = await asyncio.gather(*(s.future for s in sinks))
+        try:
+            chunk_results = await asyncio.gather(*(s.future for s in sinks))
+        except asyncio.CancelledError:
+            # the CALLER was cancelled mid-wait: its undispatched entries
+            # must not squat in the accumulator holding reserved capacity
+            # (a flood of cancelled clients would otherwise wedge the
+            # verifier at max_queue with work nobody wants)
+            await self._evict_sinks(set(sinks))
+            raise
         out: List[bool] = []
         for results in chunk_results:
             out.extend(results)
@@ -358,9 +431,10 @@ class TpuBatchVerifier:
                     break
             if not self._queue:
                 continue
+            take = self._take_for_flush()
             batch, self._queue = (
-                self._queue[: self.batch_size],
-                self._queue[self.batch_size :],
+                self._queue[:take],
+                self._queue[take:],
             )
             try:
                 await self._release(len(batch))
@@ -487,9 +561,12 @@ class TpuBatchVerifier:
                 prepared = await loop.run_in_executor(
                     self._prep_pool, self._prep, pks, msgs, sigs, bucket
                 )
+                t1 = time.monotonic()
+                self.total_prep_s += t1 - t0
                 handle = await loop.run_in_executor(
                     self._device_pool, self._launch, prepared
                 )
+                self.total_launch_s += time.monotonic() - t1
                 finish = loop.run_in_executor(
                     self._finish_pool, self._finish, handle, len(batch)
                 )
@@ -511,6 +588,7 @@ class TpuBatchVerifier:
         task.add_done_callback(self._completions.discard)
 
     async def _complete(self, batch, bucket, finish, t0) -> None:
+        t_fin = time.monotonic()
         try:
             results = await finish
         except BaseException as exc:
@@ -520,6 +598,7 @@ class TpuBatchVerifier:
             return
         finally:
             self._inflight.release()
+        self.total_finish_s += time.monotonic() - t_fin
         self.last_dispatch_s = time.monotonic() - t0
         self.total_dispatch_s += self.last_dispatch_s
         self.batches_dispatched += 1
@@ -530,6 +609,14 @@ class TpuBatchVerifier:
 
     async def close(self) -> None:
         self._closed = True
+        # Wake parked _acquire callers FIRST, before draining in-flight
+        # completions: a wedged device (tunnel dead mid-batch) can hold
+        # the completion gather below forever, and a caller parked in
+        # _cap_cond.wait() must get its "verifier closed" RuntimeError
+        # now, not after a hang that never ends. They re-check _closed
+        # under the condition and raise.
+        async with self._cap_cond:
+            self._cap_cond.notify_all()
         self._wakeup.set()
         self._flusher.cancel()
         try:

@@ -56,7 +56,14 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
-_LINK_ARGS = ("-l:libcrypto.so.3",)
+# Preferred OpenSSL soname first, but hosts differ (build VMs still ship
+# 1.1): probe each candidate until one links. The C source only uses the
+# stable EVP verify API, which is identical across both majors.
+_LINK_CANDIDATES = (
+    ("-l:libcrypto.so.3",),
+    ("-l:libcrypto.so.1.1",),
+    ("-lcrypto",),
+)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -65,7 +72,11 @@ def _load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        lib = load_lib("at2_ingest.cpp", "libat2ingest.so", _LINK_ARGS)
+        lib = None
+        for link_args in _LINK_CANDIDATES:
+            lib = load_lib("at2_ingest.cpp", "libat2ingest.so", link_args)
+            if lib is not None:
+                break
         if lib is None:
             return None
         lib.at2_parse_frames.argtypes = [

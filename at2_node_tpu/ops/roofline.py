@@ -20,14 +20,19 @@ roofline_pct this model reports is a LOWER bound on true utilization):
   (~200 ops) ~= 1000 int32 ops;
 * point ops in f_mul units: unified double = 4M+4S = 8, complete
   a=-1 add = 9 (8M + the 2d constant mul);
-* the Straus table lookups are NOT free: a one-hot masked sum over 16
-  window entries x 4 coords x 20 limbs = 1280 mul-adds + the one-hot
-  compare (~16) per lookup;
+* the Straus table lookups are NOT free, but the binary select tree
+  (p_select) costs 15 selects x 4 coords x 20 limbs + 4 shared bit
+  tests per lookup — ~3x cheaper than the old one-hot masked sum
+  (16 compares + 16 selects + 15 adds per coord);
 * per-signature structure (ops/pallas_verify.py, ops/edwards.py):
   2 decompressions (sqrt chain _pow_t250: 250 squarings + ~13 muls,
-  plus ~8 muls of x-recovery/sign fixup each), one 15-add window-table
-  build for A, 64 Straus windows x (4 doubles + 2 adds + 2 lookups),
-  and the final affine equality (one inversion chain ~= 254 + ~6).
+  plus ~8 muls of x-recovery/sign fixup each), the -A window table
+  built evens-by-doubling (7 doubles + 7 adds + the initial double,
+  vs 13 serial adds before), 64 Straus windows x (4 doubles + 2 adds
+  + 2 lookups + 2 in-loop nibble cuts), and the final affine equality
+  (one inversion chain ~= 254 + ~6). Window nibbles are extracted
+  in-kernel from raw scalar bytes (2 shifts/masks per window), which
+  deleted the XLA window prolog entirely.
 
 Reference cites: the kernel replaces the per-message CPU verification
 inside the reference's broadcast crates (/root/reference/technical.md:7-12).
@@ -49,7 +54,9 @@ DBL_FMUL = 8  # 4M + 4S
 ADD_FMUL = 9  # 8M + 2d-constant mul
 SQRT_CHAIN_FMUL = 250 + 13  # _pow_t250: squarings + chain muls
 DECOMPRESS_FMUL = SQRT_CHAIN_FMUL + 8  # + x-recovery, sign fixup
-TABLE_BUILD_FMUL = 15 * ADD_FMUL
+# evens-by-doubling: entries 2k = double(k) (7 doubles + the initial
+# 2A double folded in as k=1), odds 2k+1 = 2k + A (7 adds)
+TABLE_BUILD_FMUL = 8 * DBL_FMUL + 7 * ADD_FMUL
 STRAUS_FMUL = N_WINDOWS * (4 * DBL_FMUL + 2 * ADD_FMUL)  # 3200
 INVERT_FMUL = 254 + 6  # final affine equality's inversion chain
 
@@ -59,7 +66,9 @@ FMUL_PER_SIG = (
 
 # ---- lookup cost (not f_mul-shaped, counted directly) ---------------
 LOOKUPS_PER_SIG = N_WINDOWS * 2
-OPS_PER_LOOKUP = 16 * 4 * fe.N_LIMBS + 16  # one-hot masked sum + compare
+# binary select tree: 15 lane-wide selects per coordinate + 4 shared
+# index-bit tests + the 2-op in-loop nibble cut (shift + mask)
+OPS_PER_LOOKUP = 15 * 4 * fe.N_LIMBS + 4 + 2
 
 INT32_OPS_PER_SIG = (
     FMUL_PER_SIG * OPS_PER_FMUL + LOOKUPS_PER_SIG * OPS_PER_LOOKUP

@@ -20,9 +20,21 @@ broadcast plane is quadratic in nodes: a 4-node full-quorum commit costs
 all of which share this machine's core(s) with the clients and the
 loadgen itself.
 
+A third mode, ``--compose``, produces BENCH_PIPELINE.json instead: one
+self-banking run that probes the device tunnel, reruns the verify grid
+(``bench.py`` — pipelined vs device-only per bucket, with its own
+dead-tunnel fallback), measures the batched-plane ceiling, then drives
+the composed load — SendAssetBatch ingress + batched broadcast plane +
+real verification (the TPU dispatch pipeline when the chip answers, a
+clearly-labeled CpuVerifier/OpenSSL row when it doesn't) — and closes
+with the plane/ingress/crypto decomposition showing which term binds
+the 10k-tx/s target. Each phase is written to disk the moment it
+completes, so a wedge mid-run banks everything already measured.
+
 Usage:
     python -m at2_node_tpu.tools.e2e_bench [--clients 16]
         [--tx-per-client 50] [--skip-cpu] [--skip-tpu] [--out BENCH_E2E.json]
+    python -m at2_node_tpu.tools.e2e_bench --compose [--rpc-batch 64]
 """
 
 from __future__ import annotations
@@ -139,19 +151,58 @@ def _phase_cpu_subprocess(
                 p.kill()
 
 
+def _verifier_block(shared, kind: str) -> dict:
+    """Pull the pipeline-health counters out of a verifier's stats() —
+    everything a reader needs to judge the dispatch pipeline from the
+    artifact alone (occupancy/padding for bucket shaping, per-stage ms
+    for the overlap story, queue_peak for backpressure headroom)."""
+    vstats = shared.stats()
+    block: dict = {"kind": kind}
+    for key, nd in (
+        ("batches", None),
+        ("signatures", None),
+        ("batch_occupancy", 4),
+        ("padding_ratio", 4),
+        ("avg_dispatch_ms", 2),
+        ("prep_ms_avg", 2),
+        ("launch_ms_avg", 2),
+        ("finish_ms_avg", 2),
+        ("queue_peak", None),
+        ("max_queue", None),
+    ):
+        if key in vstats:
+            v = vstats[key]
+            block[key] = round(v, nd) if nd is not None and isinstance(v, float) else v
+    return block
+
+
 async def _phase_tpu_inprocess(
-    n_nodes: int, clients: int, tx_per_client: int, rpc_batch: int = 1
+    n_nodes: int,
+    clients: int,
+    tx_per_client: int,
+    rpc_batch: int = 1,
+    window: int = 8,
+    verifier_kind: str = "tpu",
+    buckets: tuple | None = None,
 ) -> dict:
-    from ..crypto.keys import ExchangeKeyPair, SignKeyPair
-    from ..crypto.verifier import TpuBatchVerifier
-    from ..net.peers import Peer
-    from ..node.config import Config
+    from ..crypto.verifier import CpuVerifier, TpuBatchVerifier
     from ..node.service import Service
+    from ._common import make_net_configs
     from .loadgen import run_load
 
-    shared = TpuBatchVerifier(batch_size=256, max_delay=0.005)
+    if verifier_kind == "tpu":
+        shared = TpuBatchVerifier(
+            batch_size=256, max_delay=0.005, buckets=buckets
+        )
+        topology = f"{n_nodes} in-process nodes sharing one TpuBatchVerifier"
+    else:
+        # dead-tunnel fallback for --compose: same topology, same load,
+        # OpenSSL bulk verification — an honest, clearly-labeled row
+        shared = CpuVerifier()
+        topology = (
+            f"{n_nodes} in-process nodes sharing one CpuVerifier (OpenSSL)"
+        )
     await shared.warmup()
-    from ._common import make_net_configs
 
     cfgs = make_net_configs(n_nodes, _ports)
     services: List[Service] = []
@@ -163,37 +214,254 @@ async def _phase_tpu_inprocess(
             rpcs,
             clients=clients,
             tx_per_client=tx_per_client,
-            window=8,
+            window=window,
             commit_timeout=600.0,
             rpc_batch=rpc_batch,
         )
-        vstats = shared.stats()
         bstats = services[0].snapshot_stats()
-        return {
+        out = {
             "nodes": n_nodes,
-            "topology": "4 in-process nodes sharing one TpuBatchVerifier",
+            "topology": topology,
             "rpc_batch": rpc_batch,
+            "window": window,
             "clients": clients,
             "submitted": result.submitted,
             "committed": result.committed,
+            "submit_seconds": round(result.submit_seconds, 2),
+            # the ingress term: how fast the RPC surface swallowed the
+            # load, independent of how long commit convergence took
+            "ingress_tx_per_sec": round(
+                result.submitted / result.submit_seconds, 1
+            )
+            if result.submit_seconds
+            else 0.0,
             "commit_seconds": round(result.commit_seconds, 2),
             "committed_tx_per_sec": round(result.committed_tx_per_sec, 1),
-            "verifier": {
-                "batches": vstats["batches"],
-                "signatures": vstats["signatures"],
-                "batch_occupancy": round(vstats["batch_occupancy"], 4),
-                "avg_dispatch_ms": round(vstats["avg_dispatch_ms"], 2),
-            },
+            "verifier": _verifier_block(shared, verifier_kind),
             "node0_broadcast_stats": {
                 k: bstats[k]
                 for k in ("gossip_rx", "echo_rx", "ready_rx", "delivered")
                 if k in bstats
             },
         }
+        if verifier_kind != "tpu":
+            out["fallback"] = True
+            out["verifier"]["device"] = "cpu-openssl"
+        return out
     finally:
         for s in services:
             await s.close()
         await shared.close()
+
+
+# --------------------------------------------------------------------------
+# --compose: the composed 10k-tx/s story in one run -> BENCH_PIPELINE.json
+# --------------------------------------------------------------------------
+
+BENCH_PY = os.path.join(REPO, "bench.py")
+
+
+def _bank(path: str, artifact: dict) -> None:
+    """Atomic per-phase write: the artifact on disk is always the banked
+    prefix of completed phases, so a wedge mid-compose (the round-2
+    post-mortem failure mode) still leaves every finished phase behind."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fp:
+        json.dump(artifact, fp, indent=1)
+        fp.write("\n")
+    os.replace(tmp, path)
+
+
+def _probe_device(timeout: float) -> str:
+    """bench.py --probe in a subprocess: the device platform behind the
+    tunnel ('' when the tunnel is dead / the backend never came up)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH_PY, "--probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return ""
+    if proc.returncode != 0:
+        return ""
+    for line in proc.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and obj.get("probe") == "ok":
+            return str(obj.get("device", ""))
+    return ""
+
+
+def _phase_verify_grid(timeout: float) -> dict:
+    """Run the bench orchestrator — the self-banking chip queue: fresh
+    per-bucket rows (pipelined vs device-only) the moment the tunnel
+    answers, last-good re-emission plus the labeled OpenSSL fallback grid
+    when it is dead — and take its one-line JSON artifact."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH_PY],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"bench orchestrator exceeded {timeout}s"}
+    last = None
+    for line in proc.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            last = obj
+    if last is None:
+        return {
+            "error": f"bench orchestrator rc={proc.returncode}: "
+            f"{proc.stderr[-300:]}"
+        }
+    return last
+
+
+def _decompose(artifact: dict) -> dict:
+    """Which term binds? plane (broadcast commit ceiling) vs ingress (RPC
+    swallow rate) vs crypto (verifier sigs/s over measured sigs per
+    committed tx). The composed tx/s can't beat the minimum of the three;
+    the gap between min(term) and the composed figure is integration
+    overhead."""
+    composed = artifact.get("composed") or {}
+    plane = artifact.get("plane") or {}
+    grid = artifact.get("verify_grid") or {}
+    tunnel_live = bool((artifact.get("tunnel") or {}).get("live"))
+
+    committed = composed.get("committed") or 0
+    sigs = (composed.get("verifier") or {}).get("signatures") or 0
+    sigs_per_tx = round(sigs / committed, 2) if committed else None
+
+    if tunnel_live:
+        verify_rate = grid.get("value") or 0.0
+        verify_src = f"tpu pipelined, bucket {grid.get('bucket')}"
+    else:
+        rows = [
+            r
+            for r in (grid.get("cpu_fallback_grid") or {}).values()
+            if isinstance(r, dict) and "pipelined" in r
+        ]
+        best = max(rows, key=lambda r: r["pipelined"], default=None)
+        verify_rate = best["pipelined"] if best else 0.0
+        verify_src = (
+            f"cpu-openssl fallback, bucket {best['bucket']}"
+            if best
+            else "unavailable"
+        )
+
+    crypto_bound = (
+        round(verify_rate / sigs_per_tx, 1) if sigs_per_tx else None
+    )
+    terms = {
+        "plane_tx_per_sec": plane.get("committed_tx_per_sec"),
+        "ingress_tx_per_sec": composed.get("ingress_tx_per_sec"),
+        "crypto_bound_tx_per_sec": crypto_bound,
+    }
+    live_terms = {
+        k: v for k, v in terms.items() if isinstance(v, (int, float)) and v > 0
+    }
+    composed_rate = composed.get("committed_tx_per_sec") or 0.0
+    return {
+        **terms,
+        "sigs_per_committed_tx": sigs_per_tx,
+        "verify_rate_sigs_per_sec": verify_rate,
+        "verify_rate_source": verify_src,
+        "binding_term": min(live_terms, key=live_terms.get)
+        if live_terms
+        else None,
+        "composed_tx_per_sec": composed_rate,
+        "target_met": composed_rate >= 10_000,
+    }
+
+
+def _compose(args) -> int:
+    from ._common import host_context
+
+    from . import plane_bench
+
+    out_path = args.out or os.path.join(REPO, "BENCH_PIPELINE.json")
+    probe_timeout = float(os.environ.get("AT2_BENCH_PROBE_TIMEOUT", "180"))
+    grid_timeout = float(os.environ.get("AT2_COMPOSE_GRID_TIMEOUT", "3000"))
+    artifact: dict = {
+        "config": (
+            "composed run: batched plane + SendAssetBatch ingress + "
+            "dispatch pipeline"
+        ),
+        "host_context": host_context(),
+        "target_tx_per_sec": 10_000,
+        "phases_completed": [],
+    }
+    _bank(out_path, artifact)
+
+    # phase 0: is there a chip behind the tunnel? (decides the composed
+    # run's verifier AND how the crypto term is sourced)
+    device = _probe_device(probe_timeout)
+    tunnel_live = device == "tpu"
+    artifact["tunnel"] = {"probed_device": device or None, "live": tunnel_live}
+    artifact["phases_completed"].append("probe")
+    _bank(out_path, artifact)
+
+    # phase 1: the verify grid — pipelined vs device-only per bucket
+    # (bench.py banks row-by-row internally; a dead tunnel yields the
+    # last-good rows plus a fresh, labeled cpu-openssl fallback grid)
+    artifact["verify_grid"] = _phase_verify_grid(grid_timeout)
+    artifact["phases_completed"].append("verify_grid")
+    _bank(out_path, artifact)
+
+    # phase 2: batched broadcast-plane ceiling, verification off the
+    # critical path (what the plane does in front of an unbounded chip)
+    try:
+        artifact["plane"] = asyncio.run(
+            plane_bench.run(
+                args.nodes,
+                txs=512,
+                verifier="plane-only",
+                timeout=240.0,
+                batch=max(args.rpc_batch, 1),
+            )
+        )
+    except Exception as exc:
+        artifact["plane"] = {"error": str(exc)[:300]}
+    artifact["phases_completed"].append("plane")
+    _bank(out_path, artifact)
+
+    # phase 3: the composed run — real RPC ingress, batched plane, REAL
+    # verification end to end (TPU pipeline when the chip answers, the
+    # labeled CpuVerifier fallback row when it doesn't)
+    try:
+        artifact["composed"] = asyncio.run(
+            _phase_tpu_inprocess(
+                args.nodes,
+                args.clients,
+                args.tx_per_client,
+                rpc_batch=args.rpc_batch,
+                window=args.window,
+                verifier_kind="tpu" if tunnel_live else "cpu",
+                # the adaptive ladder only matters on the chip; on CPU the
+                # kind is CpuVerifier and buckets never reach XLA
+                buckets=(64, 256, 1024) if tunnel_live else None,
+            )
+        )
+    except Exception as exc:
+        artifact["composed"] = {"error": str(exc)[:300]}
+    artifact["phases_completed"].append("composed")
+    _bank(out_path, artifact)
+
+    # phase 4: the bottleneck decomposition the round-5 verdict demands
+    artifact["decomposition"] = _decompose(artifact)
+    artifact["phases_completed"].append("decomposition")
+    _bank(out_path, artifact)
+    print(json.dumps(artifact))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -205,13 +473,26 @@ def main(argv=None) -> int:
                     help="in-flight RPCs per client (in-flight TRANSFERS "
                     "= window x rpc_batch; match them when A/B-ing "
                     "unary vs bulk ingress)")
-    ap.add_argument("--rpc-batch", type=int, default=1,
+    ap.add_argument("--rpc-batch", type=int, default=None,
                     help="transfers per SendAssetBatch call (1 = unary "
-                    "SendAsset, the reference-parity surface)")
+                    "SendAsset, the reference-parity surface; default 1, "
+                    "or 64 under --compose where bulk ingress IS the "
+                    "story)")
     ap.add_argument("--skip-cpu", action="store_true")
     ap.add_argument("--skip-tpu", action="store_true")
+    ap.add_argument("--compose", action="store_true",
+                    help="run the composed-pipeline story instead of the "
+                    "baseline phases: probe the tunnel, run the verify "
+                    "grid (bench.py), the batched-plane ceiling, and the "
+                    "composed load with real verification; self-banking "
+                    "per-phase writes to BENCH_PIPELINE.json")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.rpc_batch is None:
+        args.rpc_batch = 64 if args.compose else 1
+
+    if args.compose:
+        return _compose(args)
 
     from ._common import host_context
 

@@ -114,6 +114,12 @@ async def run(
         dt = time.perf_counter() - t0
         committed = [s.committed for s in services]
         stats = services[0].snapshot_stats()
+        vstats = {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in sorted(
+                (injected or services[0].verifier).stats().items()
+            )
+        }
         return {
             "config": "in-process firehose (plane microbenchmark)",
             "nodes": nodes,
@@ -131,6 +137,9 @@ async def run(
                 k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in sorted(stats.items())
             },
+            # the active verifier's own pipeline counters (occupancy,
+            # padding, per-stage ms) — empty for --verifier plane-only
+            "verifier_stats": vstats,
         }
     finally:
         for s in services:

@@ -34,6 +34,14 @@ EOF
 echo "== fast tier =="
 python -m pytest tests/ -q -m "not slow"
 
+echo "== pipeline smoke gate =="
+# Dispatch-pipeline regression (ISSUE 2): 4 overlapped batches on the
+# fake device must report sane stats counters (batches, occupancy, zero
+# leaked capacity), plus the overlap/backpressure/close-race invariants.
+# Fake-device only (no XLA compile), so the gate stays in the fast tier;
+# named explicitly so a marker/collection change can never drop it.
+python -m pytest tests/test_pipeline.py -q -m "not slow"
+
 echo "== poison-slot chaos gate =="
 # Byzantine amplification regression (ISSUE 1): a bad-sig entry per
 # ingress batch must not stall slots, fire stall kicks, or trigger

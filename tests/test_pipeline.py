@@ -1,0 +1,279 @@
+"""Dispatch-pipeline tests on a fake device: overlap, backpressure,
+adaptive bucket shaping, close/cancel capacity hygiene, bitmask contract.
+
+Everything here runs with injected stage hooks (no XLA compile, no
+device), so the tier stays fast enough for the CI pipeline smoke gate
+(scripts/ci.sh) to call it by name.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from at2_node_tpu.crypto.verifier import TpuBatchVerifier
+
+
+def _items(n, tag=b"m"):
+    return [(b"p" * 32, tag + str(i).encode(), b"s" * 64) for i in range(n)]
+
+
+class FakeDevice(TpuBatchVerifier):
+    """Stage hooks that record an event log instead of touching a chip.
+
+    prep/launch/finish each append (stage, edge, batch_seq, t); the
+    handle threaded through the stages is (batch_seq, n, bucket) so the
+    log can be correlated per batch.
+    """
+
+    def __init__(self, *a, prep_s=0.0, launch_s=0.0, finish_s=0.0, **kw):
+        super().__init__(*a, **kw)
+        self.events = []
+        self.prep_log = []  # (seq, n, bucket, msgs) per dispatched batch
+        self._seq = 0
+        self._prep_s = prep_s
+        self._launch_s = launch_s
+        self._finish_s = finish_s
+
+    def _prep(self, pks, msgs, sigs, bucket):
+        seq = self._seq
+        self._seq += 1
+        self.events.append(("prep", "start", seq, time.monotonic()))
+        if self._prep_s:
+            time.sleep(self._prep_s)
+        self.events.append(("prep", "end", seq, time.monotonic()))
+        self.prep_log.append((seq, len(pks), bucket, list(msgs)))
+        return (seq, len(pks), bucket)
+
+    def _launch(self, prepared):
+        seq = prepared[0]
+        self.events.append(("launch", "start", seq, time.monotonic()))
+        if self._launch_s:
+            time.sleep(self._launch_s)
+        self.events.append(("launch", "end", seq, time.monotonic()))
+        return prepared
+
+    def _finish(self, handle, n):
+        seq = handle[0]
+        self.events.append(("finish", "start", seq, time.monotonic()))
+        if self._finish_s:
+            time.sleep(self._finish_s)
+        self.events.append(("finish", "end", seq, time.monotonic()))
+        return np.ones(n, dtype=bool)
+
+    def edge(self, stage, edge, seq):
+        for s, e, q, t in self.events:
+            if (s, e, q) == (stage, edge, seq):
+                return t
+        raise AssertionError(f"no event {(stage, edge, seq)}")
+
+
+def test_overlap_next_prep_starts_before_prior_finish_ends():
+    """The tentpole invariant: batch N+1's prep must START before batch
+    N's finish has COMPLETED — the three stages genuinely overlap across
+    consecutive batches rather than running as a serial relay."""
+
+    async def run():
+        ver = FakeDevice(
+            batch_size=4, max_delay=0.001, prep_s=0.01, finish_s=0.05
+        )
+        out = await ver.verify_many(_items(24))  # 6 batches of 4
+        assert out == [True] * 24
+        assert ver.batches_dispatched == 6
+        overlapped = sum(
+            1
+            for seq in range(1, 6)
+            if ver.edge("prep", "start", seq) < ver.edge("finish", "end", seq - 1)
+        )
+        assert overlapped >= 3, f"only {overlapped}/5 successor preps overlapped"
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_backpressure_flood_is_bounded_and_fifo():
+    """Flooding far past max_queue must (a) keep the accumulator bounded
+    at max_queue — memory does not scale with offered load — and (b)
+    preserve FIFO order within each caller's chunk stream."""
+
+    async def run():
+        ver = FakeDevice(
+            batch_size=4, max_delay=0.001, max_queue=8, finish_s=0.005
+        )
+        callers = [
+            asyncio.ensure_future(ver.verify_many(_items(16, tag=b"c%d-" % c)))
+            for c in range(4)
+        ]
+        results = await asyncio.gather(*callers)
+        for r in results:
+            assert r == [True] * 16
+        assert ver.queue_peak <= ver.max_queue, (
+            f"queue grew to {ver.queue_peak} past the {ver.max_queue} bound"
+        )
+        # FIFO within each caller: its items were dispatched in the order
+        # they were enqueued (single flusher pops the accumulator in order)
+        order = {}
+        for _seq, _n, _bucket, batch_msgs in ver.prep_log:
+            for m in batch_msgs:
+                caller, idx = m.split(b"-", 1)
+                order.setdefault(caller, []).append(int(idx))
+        assert len(order) == 4
+        for caller, idx in order.items():
+            assert idx == sorted(idx), f"caller {caller} reordered: {idx}"
+        # no leaked capacity once everything drained
+        assert ver._cap_free == ver.max_queue
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_adaptive_bucket_shrinks_timer_flush():
+    """A 3-item timer flush on a (4, 8, 16) ladder must dispatch in the
+    4-lane bucket, not pad 13 dead lanes into the 16 shape."""
+
+    async def run():
+        ver = FakeDevice(batch_size=16, max_delay=0.01, buckets=(4, 8, 16))
+        out = await ver.verify_many(_items(3))
+        assert out == [True] * 3
+        buckets = [b for _, _, b, _ in ver.prep_log]
+        assert buckets == [4], buckets
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_adaptive_bucket_coalesces_backlog():
+    """A backlog deeper than batch_size must coalesce into the largest
+    bucket it can fill: 16 queued items on a (4, 16) ladder go out as ONE
+    16-lane dispatch, not four 4-lane ones."""
+
+    async def run():
+        ver = FakeDevice(batch_size=4, max_delay=10.0, buckets=(4, 16))
+        out = await ver.verify_many(_items(16))
+        assert out == [True] * 16
+        assert ver.batches_dispatched == 1
+        buckets = [b for _, _, b, _ in ver.prep_log]
+        assert buckets == [16], buckets
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_close_releases_parked_acquirer_with_wedged_device():
+    """A caller parked in _acquire when close() lands must get the
+    'verifier closed' RuntimeError promptly — even while a wedged device
+    holds an in-flight completion open (the old close drained completions
+    BEFORE notifying, so a dead tunnel turned close into a global hang)."""
+
+    async def run():
+        ver = FakeDevice(
+            batch_size=4, max_delay=0.001, max_queue=4, finish_s=0.4
+        )
+        # 6 batches: 4 wedge in the (serial) finish stage, the 5th blocks
+        # the flusher on the depth gate, the 6th squats in the accumulator
+        # holding ALL the capacity — so the next caller parks in _acquire
+        first = asyncio.ensure_future(ver.verify_many(_items(24)))
+        await asyncio.sleep(0.05)
+        parked = asyncio.ensure_future(ver.verify_many(_items(4, tag=b"x-")))
+        await asyncio.sleep(0.05)
+        assert not parked.done()
+        closer = asyncio.ensure_future(ver.close())
+        # the parked caller must error out well before the 0.4s wedge ends
+        with pytest.raises(RuntimeError, match="closed"):
+            await asyncio.wait_for(asyncio.shield(parked), timeout=0.2)
+        await closer
+        await asyncio.gather(first, return_exceptions=True)
+
+    asyncio.run(run())
+
+
+def test_cancelled_caller_releases_reserved_capacity():
+    """Cancelling a verify_many caller whose entries are still queued must
+    evict them and return the reserved capacity (notify included), so the
+    next caller is not starved by dead reservations."""
+
+    async def run():
+        # nothing ever flushes: batch_size is large and max_delay long
+        ver = FakeDevice(batch_size=64, max_delay=30.0, max_queue=8)
+        caller = asyncio.ensure_future(ver.verify_many(_items(6)))
+        await asyncio.sleep(0.02)
+        assert ver._cap_free == 2
+        caller.cancel()
+        await asyncio.gather(caller, return_exceptions=True)
+        assert ver._cap_free == ver.max_queue, "cancelled capacity leaked"
+        assert not ver._queue, "cancelled entries squat in the accumulator"
+        # the freed capacity is usable immediately
+        nxt = asyncio.ensure_future(ver.verify_many(_items(8, tag=b"y")))
+        await asyncio.sleep(0.02)
+        assert ver._cap_free == 0
+        nxt.cancel()
+        await asyncio.gather(nxt, return_exceptions=True)
+        await ver.close()
+
+    asyncio.run(run())
+
+
+def test_pipeline_smoke_stats():
+    """The CI smoke gate (scripts/ci.sh): 4 overlapped batches on the fake
+    device; stats counters must report the batches, full occupancy, the
+    per-stage timings, and ZERO leaked capacity."""
+
+    async def run():
+        ver = FakeDevice(batch_size=4, max_delay=0.001, finish_s=0.01)
+        out = await ver.verify_many(_items(16))
+        assert out == [True] * 16
+        st = ver.stats()
+        assert st["batches"] == 4
+        assert st["signatures"] == 16
+        assert st["batch_occupancy"] == 1.0
+        assert st["padding_ratio"] == 0.0
+        assert st["capacity_free"] == st["max_queue"], "leaked capacity"
+        assert st["queue_depth"] == 0
+        assert st["finish_ms_avg"] > 0.0
+        assert st["avg_dispatch_ms"] > 0.0
+        await ver.close()
+        # close() must not disturb the drained counters
+        assert ver.stats()["capacity_free"] == ver.max_queue
+
+    asyncio.run(run())
+
+
+def test_finish_packed_bitmask_roundtrip():
+    """finish_packed's device-bitmask contract: a packed MSB-first bit
+    vector unpacks to exactly the first n lanes' verdicts, for every
+    alignment (n % 8 included)."""
+    from at2_node_tpu.ops.ed25519 import _InFlight, finish_packed
+
+    rng = np.random.default_rng(3)
+    for n in (1, 5, 8, 12, 64, 129):
+        verdicts = rng.integers(0, 2, size=n).astype(bool)
+        bits = np.packbits(verdicts)  # MSB-first, same as jnp.packbits
+        out = finish_packed(_InFlight(bits, None), n)
+        assert out.dtype == bool and out.shape == (n,)
+        assert (out == verdicts).all(), n
+    # legacy handles (PoolVerifier's sharded output) still work: a plain
+    # bool vector, possibly padded past n
+    legacy = np.ones(16, dtype=bool)
+    assert (finish_packed(legacy, 10) == np.ones(10, dtype=bool)).all()
+
+
+def test_staging_pool_recycles_buffers():
+    """The host staging pool must hand back released buffers instead of
+    allocating fresh ones, and never grow past its cap."""
+    from at2_node_tpu.ops import ed25519 as kernel
+
+    with kernel._STAGING_LOCK:
+        kernel._STAGING.pop(256, None)
+    a = kernel._staging_acquire(256)
+    b = kernel._staging_acquire(256)
+    assert a is not b
+    kernel._staging_release(a)
+    assert kernel._staging_acquire(256) is a
+    kernel._staging_release(a)
+    kernel._staging_release(b)
+    for _ in range(32):  # overfill: the pool must stay capped
+        kernel._staging_release(np.empty((256, kernel.PACKED_WIDTH), np.uint8))
+    with kernel._STAGING_LOCK:
+        assert len(kernel._STAGING[256]) <= kernel._STAGING_CAP_PER_BUCKET
+        kernel._STAGING.pop(256, None)
