@@ -406,6 +406,8 @@ class Broadcast:
         echo_threshold: Optional[int] = None,
         ready_threshold: Optional[int] = None,
         workers: int = 16,
+        registry=None,
+        trace=None,
     ) -> None:
         self.keypair = keypair
         self.mesh = mesh
@@ -470,29 +472,44 @@ class Broadcast:
         self.stall_handler = None
         self._stall_last_kick = float("-inf")
         self._stall_backoff = STALL_KICK_MIN_INTERVAL
-        # observability counters (SURVEY.md §5: per-stage counters)
-        self.stats = {
-            "gossip_rx": 0,
-            "echo_rx": 0,
-            "ready_rx": 0,
-            "invalid_sig": 0,
-            "delivered": 0,
-            "slots_dropped": 0,
-            "content_req_tx": 0,
-            "content_req_rx": 0,
-            "content_served": 0,
-            "batch_rx": 0,
-            "batch_echo_rx": 0,
-            "batch_ready_rx": 0,
-            "batch_entries_delivered": 0,
-            "retransmits": 0,
+        # observability (SURVEY.md §5: per-stage counters). The service
+        # passes its registry + tx-lifecycle tracer; a standalone stack
+        # (unit tests, bench harnesses) gets a private registry and no
+        # tracing. CounterGroup keeps the ``stats["k"] += 1`` surface.
+        from ..obs.registry import Registry
+
+        self.registry = Registry() if registry is None else registry
+        self.trace = trace
+        self.registry.gauge(
+            "slots_undelivered", "live undelivered broadcast slots",
+            fn=lambda: self._undelivered,
+        )
+        self.registry.gauge(
+            "inbox_depth", "raw frames queued for the broadcast workers",
+            fn=lambda: self._inbox.qsize(),
+        )
+        self.stats = self.registry.counter_group((
+            "gossip_rx",
+            "echo_rx",
+            "ready_rx",
+            "invalid_sig",
+            "delivered",
+            "slots_dropped",
+            "content_req_tx",
+            "content_req_rx",
+            "content_served",
+            "batch_rx",
+            "batch_echo_rx",
+            "batch_ready_rx",
+            "batch_entries_delivered",
+            "retransmits",
             # robustness counters (poison-entry resolution, PR 1):
             # entries resolved by local rejection when their slot retired,
             # retired slots, and stall kicks absorbed by the hysteresis
-            "poison_resolved": 0,
-            "slots_retired": 0,
-            "stall_kicks_suppressed": 0,
-        }
+            "poison_resolved",
+            "slots_retired",
+            "stall_kicks_suppressed",
+        ))
 
     async def start(self) -> None:
         # Pre-build the native ingest library off-loop HERE — broadcast is
@@ -1046,6 +1063,8 @@ class Broadcast:
                 if bound is None:
                     self._entry_registry.put(slot, body)
                 state.echoed_hash = chash
+                if self.trace is not None:
+                    self.trace.stamp(slot, "echoed")
                 self._send_attestation(
                     ECHO, payload.sender, payload.sequence, chash
                 )
@@ -1274,6 +1293,8 @@ class Broadcast:
                     rejected |= 1 << i
                     continue
                 bits |= 1 << i
+                if self.trace is not None:
+                    self.trace.stamp(ekey, "echoed")
             state.own_echo_bits[chash] = bits
             state.rejected_bits[chash] = rejected
             if bits:
@@ -1418,7 +1439,13 @@ class Broadcast:
         while d:
             lsb = d & -d
             i = lsb.bit_length() - 1
-            self.delivered.put_nowait(entries[i])
+            p = entries[i]
+            if self.trace is not None:
+                # on the batched plane an entry's Ready quorum IS its
+                # delivery condition, so the two stamps coincide here
+                self.trace.stamp((p.sender, p.sequence), "ready_quorum")
+                self.trace.stamp((p.sender, p.sequence), "delivered")
+            self.delivered.put_nowait(p)
             self.stats["batch_entries_delivered"] += 1
             d ^= lsb
         if state.delivered_bits[chash] == (1 << batch.count) - 1:
@@ -1605,10 +1632,15 @@ class Broadcast:
             self._send_attestation(READY, slot[0], slot[1], chash)
         # deliver: enough readies AND the payload content is known
         if len(state.readies[chash]) >= self.ready_threshold and state.ready_sent:
+            if self.trace is not None:
+                # slot IS the tracer key (sender, sequence)
+                self.trace.stamp(slot, "ready_quorum")
             if chash in state.contents:
                 state.delivered = True
                 self._undelivered -= 1
                 self.stats["delivered"] += 1
+                if self.trace is not None:
+                    self.trace.stamp(slot, "delivered")
                 self.delivered.put_nowait(state.contents[chash])
             else:
                 # quorum reached but the gossip never landed here: pull the

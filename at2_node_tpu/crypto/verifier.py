@@ -27,6 +27,7 @@ from typing import List, Protocol, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.registry import Histogram
 from .keys import verify_one
 
 
@@ -241,6 +242,21 @@ class TpuBatchVerifier:
         self.total_launch_s = 0.0
         self.total_finish_s = 0.0
         self.queue_peak = 0
+        # Per-batch latency DISTRIBUTIONS (obs/registry.py): the stage
+        # means above tell you where the average batch goes; these tell
+        # you what the tail does (p99 queue-wait is the number that
+        # bounds client-visible admission latency under load). Standalone
+        # histograms — the owning Service surfaces them through
+        # stats()/stage_histograms(), so they need no registry.
+        self.h_queue_wait = Histogram(
+            "queue_wait", "enqueue -> dispatch wait of a batch's oldest item"
+        )
+        self.h_prep = Histogram("prep", "host-side prep stage per batch")
+        self.h_launch = Histogram("launch", "device launch stage per batch")
+        self.h_finish = Histogram("finish", "device sync + readback per batch")
+        self.h_dispatch = Histogram(
+            "dispatch", "prep -> results pipeline latency per batch"
+        )
 
     def stats(self) -> dict:
         """Operator-facing counters: batch occupancy, padding ratio, and
@@ -270,6 +286,20 @@ class TpuBatchVerifier:
             "prep_ms_avg": (1e3 * self.total_prep_s / n_b) if n_b else 0.0,
             "launch_ms_avg": (1e3 * self.total_launch_s / n_b) if n_b else 0.0,
             "finish_ms_avg": (1e3 * self.total_finish_s / n_b) if n_b else 0.0,
+            # queue-wait DISTRIBUTION: the tail the means can't show
+            # (benches bank p50/p99 from here — ISSUE 3 satellite)
+            **self.h_queue_wait.flat("queue_wait"),
+        }
+
+    def stage_histograms(self) -> dict:
+        """Per-stage latency distributions (count/sum/max/p50/p90/p99 in
+        ms) for /statusz — the pipeline's shape under live load."""
+        return {
+            "queue_wait": self.h_queue_wait.snapshot(),
+            "prep": self.h_prep.snapshot(),
+            "launch": self.h_launch.snapshot(),
+            "finish": self.h_finish.snapshot(),
+            "dispatch": self.h_dispatch.snapshot(),
         }
 
     def _bucket_for(self, n: int) -> int:
@@ -552,6 +582,11 @@ class TpuBatchVerifier:
         msgs = [p.message for p in batch]
         sigs = [p.signature for p in batch]
 
+        # queue wait of the OLDEST item (FIFO queue: batch[0]), observed
+        # BEFORE the depth gate — waiting for an in-flight slot is queue
+        # time from the caller's perspective, exactly what the admission
+        # path's latency budget pays
+        self.h_queue_wait.observe(time.monotonic() - batch[0].enqueued_at)
         await self._inflight.acquire()
         # clock starts AFTER the depth gate: avg/last_dispatch_ms measure
         # one batch's prep->results pipeline latency, not queue wait
@@ -563,10 +598,13 @@ class TpuBatchVerifier:
                 )
                 t1 = time.monotonic()
                 self.total_prep_s += t1 - t0
+                self.h_prep.observe(t1 - t0)
                 handle = await loop.run_in_executor(
                     self._device_pool, self._launch, prepared
                 )
-                self.total_launch_s += time.monotonic() - t1
+                t2 = time.monotonic()
+                self.total_launch_s += t2 - t1
+                self.h_launch.observe(t2 - t1)
                 finish = loop.run_in_executor(
                     self._finish_pool, self._finish, handle, len(batch)
                 )
@@ -598,9 +636,12 @@ class TpuBatchVerifier:
             return
         finally:
             self._inflight.release()
-        self.total_finish_s += time.monotonic() - t_fin
-        self.last_dispatch_s = time.monotonic() - t0
+        t_done = time.monotonic()
+        self.total_finish_s += t_done - t_fin
+        self.h_finish.observe(t_done - t_fin)
+        self.last_dispatch_s = t_done - t0
         self.total_dispatch_s += self.last_dispatch_s
+        self.h_dispatch.observe(self.last_dispatch_s)
         self.batches_dispatched += 1
         self.signatures_verified += len(batch)
         self.total_padding += bucket - len(batch)

@@ -406,6 +406,57 @@ class PortMux:
             await writer.drain()
             return keep
 
+        if method.upper() == "GET":
+            # Observability endpoints (/metrics /healthz /statusz),
+            # answered by the servicer when it implements obs_http (duck-
+            # typed: test doubles and bare servicers just 404). Riding
+            # THIS loop — not a separate listener — is deliberate: GETs
+            # share _MAX_HTTP1_CONNS, the per-connection request cap, and
+            # the 30s per-request bound with grpc-web traffic, so a
+            # scrape flood cannot pin handler tasks beyond what the
+            # grpc-web path already tolerates.
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                keep = False  # a GET with a chunked body isn't worth decoding
+            else:
+                try:
+                    get_len = int(headers.get("content-length", "0"))
+                except ValueError:
+                    get_len = -1
+                if get_len < 0 or get_len > _MAX_BODY:
+                    keep = False
+                else:
+                    while len(buf) < get_len:
+                        chunk = await reader.read(65536)
+                        if not chunk:
+                            return False
+                        buf.extend(chunk)
+                    del buf[:get_len]
+            handler = getattr(self.servicer, "obs_http", None)
+            route = path.split("?", 1)[0]
+            result = None
+            if callable(handler):
+                try:
+                    result = handler(route)
+                except Exception:
+                    logger.exception("obs handler failed for %s", route)
+                    await self._respond(
+                        writer, "500 Internal Server Error", "text/plain",
+                        b"", keep=keep,
+                    )
+                    return keep
+            if result is None:
+                await self._respond(
+                    writer, "404 Not Found", "text/plain", b"not found",
+                    keep=keep,
+                )
+                return keep
+            status, content_type, body = result
+            reason = {200: "OK", 503: "Service Unavailable"}.get(status, "OK")
+            await self._respond(
+                writer, f"{status} {reason}", content_type, body, keep=keep
+            )
+            return keep
+
         if method.upper() != "POST":
             await self._respond(writer, "405 Method Not Allowed", "text/plain", b"")
             return False

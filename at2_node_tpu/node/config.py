@@ -17,8 +17,11 @@ Two conscious additions over the reference schema:
   `max_delay` — the plugin selection the BASELINE north star requires
   (SURVEY.md §5 "config/flag system");
 * an optional `[observability]` table — `stats_interval` (seconds between
-  structured stats log lines; 0 disables) and `profile_dir` (when set, a
-  `jax.profiler` trace of the verifier's device work is written there) —
+  structured stats log lines; 0 disables), `profile_dir` (when set, a
+  `jax.profiler` trace of the verifier's device work is written there),
+  `endpoints` (GET /metrics /healthz /statusz on the public RPC port),
+  and `trace_sample` / `trace_cap` (tx-lifecycle tracer sampling and
+  cardinality bounds, obs/trace.py) —
   SURVEY.md §5's "per-stage counters + jax.profiler from day 1";
 * an optional `[checkpoint]` table — `path` (ledger snapshot file;
   restored on start when present) and `interval` (seconds between
@@ -72,8 +75,25 @@ class VerifierConfig:
 
 @dataclass
 class ObservabilityConfig:
+    """Runtime telemetry (obs/ package, TECHNICAL.md "Observability").
+    ``endpoints`` serves GET /metrics, /healthz, /statusz on the node's
+    public RPC port through the mux (on by default: the endpoints are
+    read-only views and share the mux's connection caps).
+    ``trace_sample`` = trace every Nth ingress transaction through the
+    lifecycle tracker (1 = all, 0 = off); ``trace_cap`` bounds live
+    (uncommitted) traces — see obs/trace.py for the eviction policy."""
+
     stats_interval: float = 0.0  # seconds between stats lines; 0 = off
     profile_dir: str = ""  # jax.profiler trace output dir; "" = off
+    endpoints: bool = True  # GET /metrics /healthz /statusz on the mux
+    trace_sample: int = 1  # trace every Nth ingress tx; 0 disables
+    trace_cap: int = 8192  # max live (uncommitted) traces
+
+    def __post_init__(self) -> None:
+        if self.trace_sample < 0:
+            raise ValueError("observability.trace_sample must be >= 0")
+        if self.trace_cap < 1:
+            raise ValueError("observability.trace_cap must be >= 1")
 
 
 @dataclass
@@ -205,12 +225,15 @@ class Config:
             f"max_delay = {self.verifier.max_delay}",
         ]
         obs = self.observability
-        if obs.stats_interval or obs.profile_dir:
+        if obs != ObservabilityConfig():
             lines += [
                 "",
                 "[observability]",
                 f"stats_interval = {obs.stats_interval}",
                 f'profile_dir = "{obs.profile_dir}"',
+                f"endpoints = {'true' if obs.endpoints else 'false'}",
+                f"trace_sample = {obs.trace_sample}",
+                f"trace_cap = {obs.trace_cap}",
             ]
         if self.checkpoint.path:
             lines += [

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import heapq
+import json
 import logging
 import secrets
 import time
@@ -50,6 +51,8 @@ from ..ledger.accounts import AccountModificationError, Accounts
 from ..ledger.recent import RecentTransactions
 from ..net.peers import Mesh, Peer
 from ..net.webmux import PortMux
+from ..obs.registry import Registry
+from ..obs.trace import TxTrace
 from ..proto import at2_pb2 as pb
 from ..proto.rpc import At2Servicer, add_to_server
 from ..types import ThinTransaction, TransactionState, rfc3339
@@ -111,7 +114,18 @@ class _CatchupSession:
         self.stored_by_peer: Dict[bytes, int] = {}
 
 
+# module-level latch: repeated Service.start in one process (tests, bench
+# tools, multi-node harnesses) must configure the stats logger exactly
+# once — the handler check alone would re-attach after a caller's
+# removeHandler/clear, silently doubling every line
+_stats_logging_enabled = False
+
+
 def _enable_stats_logging() -> None:
+    global _stats_logging_enabled
+    if _stats_logging_enabled:
+        return
+    _stats_logging_enabled = True
     if not stats_logger.handlers:
         handler = logging.StreamHandler()
         handler.setFormatter(
@@ -129,6 +143,19 @@ class Service(At2Servicer):
         self.config = config
         self.accounts = Accounts()
         self.recent = RecentTransactions()
+        # Per-Service metrics registry (obs/registry.py): every counter,
+        # gauge, and histogram this node exposes lives here, and
+        # snapshot_stats() / the GET endpoints are pure views over it.
+        # Per-instance, not process-global: tests and bench tools run
+        # many Services in one process.
+        self.registry = Registry()
+        obs = config.observability
+        self.tx_trace = TxTrace(
+            self.registry,
+            sample_every=obs.trace_sample,
+            cap=obs.trace_cap,
+        )
+        self._started_at = time.monotonic()
         self.verifier: Optional[Verifier] = None
         self.mesh: Optional[Mesh] = None
         self.broadcast: Optional[Broadcast] = None
@@ -163,14 +190,18 @@ class Service(At2Servicer):
         self.history = hist.CommittedHistory(config.catchup.history_cap)
         self._catchup_session: Optional[_CatchupSession] = None
         self._catchup_task: Optional[asyncio.Task] = None
-        self.catchup_stats = {
-            "catchup_sessions": 0,
-            "catchup_applied": 0,
-            "catchup_idx_req_rx": 0,
-            "catchup_hist_req_rx": 0,
-            "catchup_served": 0,
-            "catchup_throttled": 0,
-        }
+        # registry-backed with the dict call-site surface intact
+        # (obs/registry.py CounterGroup docstring)
+        self.catchup_stats = self.registry.counter_group(
+            (
+                "catchup_sessions",
+                "catchup_applied",
+                "catchup_idx_req_rx",
+                "catchup_hist_req_rx",
+                "catchup_served",
+                "catchup_throttled",
+            )
+        )
         # per-(peer, kind) serving budgets: [window_start, used]
         self._serve_budget: Dict[tuple, list] = {}
         self._idx_serve_offset = 0  # rotating HistoryIndex window
@@ -187,10 +218,34 @@ class Service(At2Servicer):
         # buckets charged ONLY for entries that fail pre-verification —
         # source -> [tokens, refill_stamp]
         self._admission_buckets: Dict[str, list] = {}
-        self.admission_stats = {
-            "rejected_at_ingress": 0,
-            "admission_throttled": 0,
-        }
+        self.admission_stats = self.registry.counter_group(
+            ("rejected_at_ingress", "admission_throttled")
+        )
+        # commit progress + queue depths as lazy gauges; transport /
+        # verifier stats() dicts as prefixed providers — together these
+        # make registry.snapshot() reproduce the exact key families the
+        # hand-rolled snapshot_stats() used to assemble
+        self.registry.gauge(
+            "committed", "payloads committed to the ledger",
+            fn=lambda: self.committed,
+        )
+        self.registry.gauge(
+            "pending", "payloads parked in the commit retry heap",
+            fn=lambda: len(self._heap),
+        )
+        self.registry.gauge(
+            "history_retained", "payloads retained for peer catchup",
+            fn=lambda: len(self.history),
+        )
+        self.registry.register_provider("verifier_", self._verifier_stats)
+        self.registry.register_provider(
+            "mesh_",
+            lambda: self.mesh.stats() if self.mesh is not None else {},
+        )
+        self.registry.register_provider(
+            "rpc_",
+            lambda: self._mux.stats() if self._mux is not None else {},
+        )
 
     # -- lifecycle --------------------------------------------------------
 
@@ -244,6 +299,8 @@ class Service(At2Servicer):
                 service.verifier,
                 echo_threshold=config.echo_threshold,
                 ready_threshold=config.ready_threshold,
+                registry=service.registry,
+                trace=service.tx_trace,
             )
             service.broadcast.catchup_handler = service._on_catchup
             if config.catchup.enabled:
@@ -417,38 +474,109 @@ class Service(At2Servicer):
 
     # -- observability ---------------------------------------------------
 
+    def _verifier_stats(self) -> dict:
+        if self.verifier is None:
+            return {}
+        fn = getattr(self.verifier, "stats", None)
+        return fn() if callable(fn) else {}
+
     def snapshot_stats(self) -> dict:
         """One structured stats record: broadcast per-stage counters +
-        verifier batch metrics + commit progress (SURVEY.md §5)."""
-        out = {"committed": self.committed, "pending": len(self._heap)}
-        out.update(self.catchup_stats)
-        out.update(self.admission_stats)
-        out["history_retained"] = len(self.history)
-        if self.broadcast is not None:
-            out.update(self.broadcast.stats)
-        if self.verifier is not None:
-            verifier_stats = getattr(self.verifier, "stats", None)
-            if callable(verifier_stats):
-                out.update(
-                    {f"verifier_{k}": v for k, v in verifier_stats().items()}
-                )
-        if self.mesh is not None:
-            out.update({f"mesh_{k}": v for k, v in self.mesh.stats().items()})
-        if self._mux is not None:
-            out.update({f"rpc_{k}": v for k, v in self._mux.stats().items()})
-        return out
+        verifier batch metrics + commit progress (SURVEY.md §5). Now a
+        pure registry view — every key comes from exactly one instrument
+        or provider, so nothing is counted twice."""
+        return self.registry.snapshot()
 
     async def _stats_loop(self, interval: float) -> None:
         while True:
             await asyncio.sleep(interval)
             snap = self.snapshot_stats()
+            # one JSON object per line, keys sorted: machine-parseable
+            # (jq / pandas) where the old space-joined k=v repr was not
             stats_logger.info(
-                "stats %s",
-                " ".join(
-                    f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
-                    for k, v in sorted(snap.items())
-                ),
+                "%s", json.dumps(snap, sort_keys=True, default=float)
             )
+
+    # HTTP GET surface, served through PortMux's HTTP/1 keep-alive loop
+    # (net/webmux.py): the mux routes GETs here, so scrapes share the
+    # grpc-web path's _MAX_HTTP1_CONNS / per-connection request cap /
+    # per-request timeout — a scrape flood cannot pin handler tasks
+    # beyond what grpc-web traffic already could.
+
+    _OBS_JSON = "application/json; charset=utf-8"
+    _OBS_PROM = "text/plain; version=0.0.4; charset=utf-8"
+
+    def obs_http(self, path: str):
+        """Route one GET. Returns (status, content_type, body) or None
+        for 404 (unknown path, or endpoints disabled in config)."""
+        if not self.config.observability.endpoints:
+            return None
+        if path == "/metrics":
+            body = self.registry.render_prometheus().encode()
+            return 200, self._OBS_PROM, body
+        if path == "/healthz":
+            verdict = self.health_verdict()
+            status = 200 if verdict["status"] == "ok" else 503
+            body = json.dumps(verdict, sort_keys=True).encode()
+            return status, self._OBS_JSON, body
+        if path == "/statusz":
+            body = json.dumps(
+                self.statusz(), sort_keys=True, default=float
+            ).encode()
+            return 200, self._OBS_JSON, body
+        return None
+
+    def health_verdict(self) -> dict:
+        """Liveness + quorum/stall verdict. ``status`` is "ok" only when
+        the node is not shutting down, enough peer channels are up that
+        a broadcast can reach its ready quorum, and no pending payload
+        has been gap-blocked past the catchup trigger horizon."""
+        now = time.monotonic()
+        peers_total = len(self.config.nodes)
+        channels = 0
+        if self.mesh is not None:
+            try:
+                channels = int(self.mesh.stats().get("channels", 0))
+            except Exception:
+                pass
+        need = peers_total
+        if self.broadcast is not None:
+            # ready quorum counts this node's own attestation, so
+            # peers_needed = threshold - 1 remote channels
+            need = max(0, self.broadcast.ready_threshold - 1)
+        quorum_ok = peers_total == 0 or channels >= min(need, peers_total)
+        oldest = min((e[1] for e in self._heap), default=None)
+        stall_horizon = max(self.config.catchup.after * 2, 5.0)
+        stalled = oldest is not None and now - oldest > stall_horizon
+        ok = quorum_ok and not stalled and not self._closing
+        return {
+            "status": "ok" if ok else "degraded",
+            "closing": self._closing,
+            "peers_configured": peers_total,
+            "peers_connected": channels,
+            "quorum_ok": quorum_ok,
+            "stalled": stalled,
+            "pending": len(self._heap),
+            "committed": self.committed,
+            "uptime_s": round(now - self._started_at, 3),
+        }
+
+    def statusz(self) -> dict:
+        """Full JSON snapshot for /statusz and tools/top.py: flat stats
+        + tx-lifecycle percentiles + verifier pipeline stage histograms."""
+        stages = {}
+        if self.verifier is not None:
+            fn = getattr(self.verifier, "stage_histograms", None)
+            if callable(fn):
+                stages = fn()
+        return {
+            "node": self.config.sign_key.public.hex()[:16],
+            "rpc_address": self.config.rpc_address,
+            "health": self.health_verdict(),
+            "stats": self.snapshot_stats(),
+            "tx_lifecycle": self.tx_trace.snapshot(),
+            "verifier_stages": stages,
+        }
 
     # -- delivery → commit loop ------------------------------------------
 
@@ -656,6 +784,9 @@ class Service(At2Servicer):
                 payload.sender.hex()[:16],
             )
             self.committed += 1
+            self.tx_trace.stamp(
+                (payload.sender, payload.sequence), "committed"
+            )
             if key in self._catchup_keys:
                 self._catchup_commits += 1
             # retain for peers' ledger catchup (ledger/history.py)
@@ -1076,9 +1207,23 @@ class Service(At2Servicer):
             + (f" (entries {bad})" if len(payloads) > 1 else ""),
         )
 
+    def _trace_begin(self, payloads: List[Payload]) -> None:
+        if self.tx_trace.enabled:
+            now = time.monotonic()
+            for p in payloads:
+                self.tx_trace.begin((p.sender, p.sequence), now)
+
+    def _trace_stamp(self, payloads: List[Payload], stage: str) -> None:
+        if self.tx_trace.enabled:
+            now = time.monotonic()
+            for p in payloads:
+                self.tx_trace.stamp((p.sender, p.sequence), stage, now)
+
     async def SendAsset(self, request, context):
         payload = await self._validated_payload(request, context)
+        self._trace_begin([payload])
         await self._admit([payload], context)
+        self._trace_stamp([payload], "admitted")
         await self._ingest([payload])
         return pb.SendAssetReply()
 
@@ -1104,7 +1249,9 @@ class Service(At2Servicer):
             payloads.append(
                 await self._validated_payload(req, context, f" (entry {i})")
             )
+        self._trace_begin(payloads)
         await self._admit(payloads, context)
+        self._trace_stamp(payloads, "admitted")
         await self._ingest(payloads)
         return pb.SendAssetReply()
 

@@ -1,0 +1,22 @@
+"""Observability subsystem: metrics registry, lifecycle tracing, wire
+exposition. See registry.py / trace.py module docstrings and the
+TECHNICAL.md "Observability" section for the contracts."""
+
+from .registry import (
+    Counter,
+    CounterGroup,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from .trace import STAGES, TxTrace
+
+__all__ = [
+    "Counter",
+    "CounterGroup",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "STAGES",
+    "TxTrace",
+]

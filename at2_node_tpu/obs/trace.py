@@ -1,0 +1,137 @@
+"""Per-transaction lifecycle tracing: ingress → … → committed.
+
+``TxTrace`` answers the question the flat counters cannot: WHERE does a
+transfer spend its time between arriving on the RPC surface and landing
+in the ledger? Each traced transaction is stamped through the stage
+ladder
+
+    ingress → admitted → echoed → ready_quorum → delivered → committed
+
+and every stamp feeds a ``tx_ingress_to_<stage>`` histogram measured
+from the ingress timestamp, so ``/statusz`` can report p50/p99 for any
+prefix of the pipeline (ingress→commit being the headline number).
+
+Cardinality control — a tracer must never become the memory leak it is
+supposed to find:
+
+* **Sampling**: only every Nth transaction seen at ingress is traced
+  (``sample_every``; 1 = all, 0 = disabled). Stamps for untraced keys
+  are a single dict miss.
+* **Cap**: at most ``cap`` live (uncommitted) traces; beginning a new
+  one past the cap evicts the oldest, counted in ``tx_trace_evicted``.
+  A transaction that never commits (rejected, byzantine, equivocated)
+  therefore ages out instead of pinning memory forever.
+
+Stamps are idempotent and order-tolerant: a duplicate or backwards stamp
+(the batched plane can deliver before the per-entry echo bookkeeping
+runs; retransmits re-echo) is ignored, so each histogram sees each
+transaction at most once.
+
+Keys are ``(sender_public_key, sequence)`` — the identity the broadcast
+plane itself dedups on. Only transactions that entered through THIS
+node's RPC ingress are traced (relayed traffic has no local ingress
+time), so the percentiles are end-to-end client latency as this node's
+clients experience it.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .registry import Histogram, Registry
+
+__all__ = ["STAGES", "TxTrace"]
+
+STAGES: tuple[str, ...] = (
+    "ingress",
+    "admitted",
+    "echoed",
+    "ready_quorum",
+    "delivered",
+    "committed",
+)
+_STAGE_IDX = {s: i for i, s in enumerate(STAGES)}
+
+
+class TxTrace:
+    """Sampled, capped lifecycle tracker. Single-threaded by contract:
+    every stamp site runs on the node's event loop (RPC handlers, the
+    broadcast worker callbacks, the commit tail), so the live-trace dict
+    needs no lock — only the histograms it feeds are thread-safe."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        sample_every: int = 1,
+        cap: int = 8192,
+    ) -> None:
+        if sample_every < 0:
+            raise ValueError("sample_every must be >= 0 (0 disables)")
+        if cap < 1:
+            raise ValueError("cap must be >= 1")
+        self._sample_every = sample_every
+        self._cap = cap
+        # key -> [highest_stage_idx, ingress_monotonic]
+        self._live: dict[tuple, list] = {}
+        self._seen = 0
+        self._traced = registry.counter(
+            "tx_traced", "transactions sampled into the lifecycle tracer"
+        )
+        self._completed = registry.counter(
+            "tx_trace_completed", "traces that reached committed"
+        )
+        self._evicted = registry.counter(
+            "tx_trace_evicted", "live traces evicted at the cardinality cap"
+        )
+        self._hists: dict[str, Histogram] = {
+            s: registry.histogram(
+                f"tx_ingress_to_{s}", f"latency from ingress to {s}"
+            )
+            for s in STAGES[1:]
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return self._sample_every > 0
+
+    def begin(self, key: tuple, now: float | None = None) -> None:
+        """Record ingress for ``key`` if it wins the sampling lottery."""
+        if not self._sample_every:
+            return
+        self._seen += 1
+        if self._seen % self._sample_every:
+            return
+        if key in self._live:
+            return  # client retry of an in-flight tx: keep first ingress
+        if len(self._live) >= self._cap:
+            # dicts iterate in insertion order: the first key is oldest
+            self._live.pop(next(iter(self._live)))
+            self._evicted.inc()
+        self._live[key] = [0, time.monotonic() if now is None else now]
+        self._traced.inc()
+
+    def stamp(self, key: tuple, stage: str, now: float | None = None) -> None:
+        rec = self._live.get(key)
+        if rec is None:
+            return
+        idx = _STAGE_IDX[stage]
+        if idx <= rec[0]:
+            return  # duplicate or out-of-order: first arrival wins
+        t = time.monotonic() if now is None else now
+        self._hists[stage].observe(t - rec[1])
+        rec[0] = idx
+        if stage == "committed":
+            del self._live[key]
+            self._completed.inc()
+
+    @property
+    def live(self) -> int:
+        return len(self._live)
+
+    def snapshot(self) -> dict:
+        """Per-stage histogram snapshots for /statusz."""
+        out = {
+            f"ingress_to_{s}": self._hists[s].snapshot() for s in STAGES[1:]
+        }
+        out["live_traces"] = len(self._live)
+        return out

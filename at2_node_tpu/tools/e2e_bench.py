@@ -129,6 +129,22 @@ def _phase_cpu_subprocess(
                 rpc_batch=rpc_batch,
             )
         )
+        # lifecycle percentiles come over the WIRE here — the nodes are
+        # subprocesses, so /statusz is the only window into them (and
+        # this doubles as an end-to-end exercise of the endpoint)
+        latency: dict = {}
+        try:
+            from .top import fetch_statusz
+
+            sz = asyncio.run(fetch_statusz("127.0.0.1", ports[0][1]))
+            life = sz.get("tx_lifecycle", {}).get("ingress_to_committed", {})
+            latency = {
+                "ingress_to_commit_p50_ms": life.get("p50_ms", 0.0),
+                "ingress_to_commit_p99_ms": life.get("p99_ms", 0.0),
+                "traced": life.get("count", 0),
+            }
+        except Exception:
+            pass  # older server binary / endpoint disabled: row stays honest
         return {
             "nodes": n_nodes,
             "topology": "4 server subprocesses, CPU verifier",
@@ -139,6 +155,7 @@ def _phase_cpu_subprocess(
             "committed": result.committed,
             "commit_seconds": round(result.commit_seconds, 2),
             "committed_tx_per_sec": round(result.committed_tx_per_sec, 1),
+            "latency": latency,
         }
     finally:
         for p in procs:
@@ -169,6 +186,11 @@ def _verifier_block(shared, kind: str) -> dict:
         ("finish_ms_avg", 2),
         ("queue_peak", None),
         ("max_queue", None),
+        # queue-wait distribution (ISSUE 3): the tail between enqueue
+        # and dispatch, the term the stage means can't show
+        ("queue_wait_p50_ms", 3),
+        ("queue_wait_p99_ms", 3),
+        ("queue_wait_max_ms", 3),
     ):
         if key in vstats:
             v = vstats[key]
@@ -242,6 +264,17 @@ async def _phase_tpu_inprocess(
                 k: bstats[k]
                 for k in ("gossip_rx", "echo_rx", "ready_rx", "delivered")
                 if k in bstats
+            },
+            # lifecycle percentiles as node 0's tracer saw its share of
+            # the ingress (ISSUE 3 satellite: BENCH_* rows carry latency)
+            "latency": {
+                "ingress_to_commit_p50_ms": bstats.get(
+                    "tx_ingress_to_committed_p50_ms", 0.0
+                ),
+                "ingress_to_commit_p99_ms": bstats.get(
+                    "tx_ingress_to_committed_p99_ms", 0.0
+                ),
+                "traced": bstats.get("tx_trace_completed", 0),
             },
         }
         if verifier_kind != "tpu":

@@ -98,7 +98,12 @@ async def run(
                 raw = b"".join(p.encode()[1:] for p in payloads[i : i + batch])
                 batches.append(TxBatch.create(node_key, i + 1, raw))
 
+        # this tool IS the ingress (it bypasses the RPC surface), so it
+        # stamps the tracer itself — the latency block below then carries
+        # real ingress->commit percentiles for the firehose
         t0 = time.perf_counter()
+        for p in payloads:
+            services[0].tx_trace.begin((p.sender, p.sequence))
         if batch >= 1:
             for b in batches:
                 await services[0].broadcast.broadcast_batch(b)
@@ -140,6 +145,22 @@ async def run(
             # the active verifier's own pipeline counters (occupancy,
             # padding, per-stage ms) — empty for --verifier plane-only
             "verifier_stats": vstats,
+            # headline latency row (ISSUE 3 satellite): BENCH_* files
+            # carry latency, not just throughput
+            "latency": {
+                "ingress_to_commit_p50_ms": stats.get(
+                    "tx_ingress_to_committed_p50_ms", 0.0
+                ),
+                "ingress_to_commit_p99_ms": stats.get(
+                    "tx_ingress_to_committed_p99_ms", 0.0
+                ),
+                "verifier_queue_wait_p50_ms": vstats.get(
+                    "queue_wait_p50_ms", 0.0
+                ),
+                "verifier_queue_wait_p99_ms": vstats.get(
+                    "queue_wait_p99_ms", 0.0
+                ),
+            },
         }
     finally:
         for s in services:

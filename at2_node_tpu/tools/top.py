@@ -1,0 +1,170 @@
+"""Live operator dashboard: poll N nodes' /statusz and render a table.
+
+The operator view for every load run (ISSUE 3): tx/s (committed delta
+between refreshes), ingress→commit latency percentiles, verifier
+occupancy and queue-wait, broadcast slot backlog, and per-node health —
+straight from the observability endpoints the mux serves, no RPC stubs
+and no dependencies beyond the stdlib.
+
+Usage:
+    python -m at2_node_tpu.tools.top HOST:PORT [HOST:PORT ...]
+        [--interval 2.0] [--once] [--no-clear] [--json]
+
+``--once`` renders a single frame and exits (CI smoke / scripting);
+``--json`` dumps the raw per-node /statusz snapshots instead of the
+table. A node that fails to answer renders as DOWN and keeps the loop
+alive — mid-restart nodes are exactly when you want the dashboard up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+_GET_TIMEOUT = 5.0
+
+
+async def fetch_statusz(host: str, port: int, timeout: float = _GET_TIMEOUT):
+    """One raw HTTP/1 GET /statusz (no http client dependency)."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    try:
+        writer.write(
+            f"GET /statusz HTTP/1.1\r\nHost: {host}\r\n"
+            "Connection: close\r\n\r\n".encode()
+        )
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status_line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+    if " 200 " not in f"{status_line} ":
+        raise RuntimeError(f"{host}:{port} answered {status_line!r}")
+    return json.loads(body)
+
+
+def _parse_addr(spec: str):
+    host, _, port = spec.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad address {spec!r}, want HOST:PORT")
+    return host, int(port)
+
+
+def _num(snapshot: dict, key: str, default=0):
+    v = snapshot.get(key, default)
+    return v if isinstance(v, (int, float)) else default
+
+
+def render_frame(rows, now: float, prev) -> str:
+    """One table frame. ``rows`` is [(addr, statusz-or-exception)];
+    ``prev`` maps addr -> (t, committed) from the previous frame for the
+    tx/s delta. Pure function of its inputs — unit-testable."""
+    cols = (
+        f"{'node':<22}{'health':<9}{'tx/s':>8}{'committed':>11}"
+        f"{'p50 ms':>9}{'p99 ms':>9}{'vrf occ':>9}{'q-wait p99':>12}"
+        f"{'backlog':>9}{'peers':>7}"
+    )
+    lines = [cols, "-" * len(cols)]
+    for addr, sz in rows:
+        if isinstance(sz, Exception):
+            lines.append(f"{addr:<22}{'DOWN':<9}{type(sz).__name__}: {sz}")
+            continue
+        stats = sz.get("stats", {})
+        health = sz.get("health", {})
+        life = sz.get("tx_lifecycle", {}).get("ingress_to_committed", {})
+        vstages = sz.get("verifier_stages", {})
+        committed = _num(health, "committed")
+        rate = ""
+        seen = prev.get(addr)
+        if seen is not None and now > seen[0]:
+            rate = f"{(committed - seen[1]) / (now - seen[0]):.1f}"
+        occ = stats.get("verifier_batch_occupancy")
+        occ_s = f"{occ:.2f}" if isinstance(occ, float) else "-"
+        qw = vstages.get("queue_wait", {}).get("p99_ms")
+        qw_s = f"{qw:.2f}" if isinstance(qw, (int, float)) else "-"
+        lines.append(
+            f"{addr:<22}"
+            f"{health.get('status', '?'):<9}"
+            f"{rate:>8}"
+            f"{committed:>11}"
+            f"{_num(life, 'p50_ms'):>9.1f}"
+            f"{_num(life, 'p99_ms'):>9.1f}"
+            f"{occ_s:>9}"
+            f"{qw_s:>12}"
+            f"{_num(stats, 'slots_undelivered'):>9}"
+            f"{_num(health, 'peers_connected'):>4}/"
+            f"{_num(health, 'peers_configured'):<2}"
+        )
+    return "\n".join(lines)
+
+
+async def _poll(addrs, timeout: float):
+    results = await asyncio.gather(
+        *(fetch_statusz(h, p, timeout) for h, p in addrs),
+        return_exceptions=True,
+    )
+    return [(f"{h}:{p}", r) for (h, p), r in zip(addrs, results)]
+
+
+async def run(addrs, interval: float, once: bool, clear: bool,
+              as_json: bool, out=None) -> int:
+    out = out or sys.stdout
+    prev: dict = {}
+    while True:
+        now = time.monotonic()
+        rows = await _poll(addrs, min(_GET_TIMEOUT, max(interval, 0.5)))
+        if as_json:
+            print(
+                json.dumps(
+                    {a: (str(r) if isinstance(r, Exception) else r)
+                     for a, r in rows},
+                    sort_keys=True,
+                ),
+                file=out,
+            )
+        else:
+            frame = render_frame(rows, now, prev)
+            if clear:
+                print("\x1b[2J\x1b[H", end="", file=out)
+            print(frame, file=out, flush=True)
+        for addr, sz in rows:
+            if not isinstance(sz, Exception):
+                prev[addr] = (now, _num(sz.get("health", {}), "committed"))
+        if once:
+            return 0 if any(not isinstance(r, Exception) for _, r in rows) else 1
+        await asyncio.sleep(interval)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("nodes", nargs="+", metavar="HOST:PORT",
+                    help="rpc addresses of the nodes to watch")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (nonzero if ALL down)")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of clearing the screen")
+    ap.add_argument("--json", action="store_true",
+                    help="dump raw /statusz snapshots instead of the table")
+    args = ap.parse_args(argv)
+    addrs = [_parse_addr(a) for a in args.nodes]
+    try:
+        return asyncio.run(
+            run(addrs, args.interval, args.once,
+                clear=not args.no_clear, as_json=args.json)
+        )
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,14 @@ echo "== pipeline smoke gate =="
 # named explicitly so a marker/collection change can never drop it.
 python -m pytest tests/test_pipeline.py -q -m "not slow"
 
+echo "== metrics-endpoints smoke gate =="
+# Observability regression (ISSUE 3): the registry must stay exact under
+# thread + asyncio concurrency, and a live node must serve /metrics,
+# /healthz, /statusz (valid Prometheus exposition + JSON) through the
+# real PortMux on its public RPC port. Named explicitly so a marker/
+# collection change can never drop the endpoints from CI.
+python -m pytest tests/test_obs.py -q
+
 echo "== poison-slot chaos gate =="
 # Byzantine amplification regression (ISSUE 1): a bad-sig entry per
 # ingress batch must not stall slots, fire stall kicks, or trigger

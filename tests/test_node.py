@@ -282,10 +282,19 @@ class TestObservability:
                 assert snap["rpc_http1_accepted"] == 0
                 assert snap["mesh_dial_failures"] == 0
                 assert "rpc_splices" in snap
-                stats_lines = [
-                    r.message for r in caplog.records if "committed=" in r.message
-                ]
-                assert stats_lines, "no periodic stats line was logged"
+                # each stats line is one JSON object, keys sorted
+                import json
+
+                stats_objs = []
+                for r in caplog.records:
+                    try:
+                        obj = json.loads(r.message)
+                    except ValueError:
+                        continue
+                    if isinstance(obj, dict) and "committed" in obj:
+                        stats_objs.append(obj)
+                assert stats_objs, "no periodic JSON stats line was logged"
+                assert stats_objs[-1]["committed"] == 1
         finally:
             stats_logger.propagate = propagate_before
 

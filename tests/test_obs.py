@@ -1,0 +1,453 @@
+"""Observability subsystem tests (obs/ package + GET endpoints).
+
+Three tiers, mirroring how the subsystem is layered:
+
+* registry unit tests — instrument semantics (monotonicity, histogram
+  bucket math, percentile interpolation), thread + asyncio concurrency
+  exactness (the verifier bumps histograms from worker threads while the
+  broadcast plane bumps counters on the event loop), CounterGroup
+  dict-compat;
+* TxTrace behavior — sampling lottery, cardinality cap eviction,
+  idempotent / order-tolerant stamps;
+* endpoint e2e — raw HTTP/1.1 GETs against the same public PortMux port
+  that serves native gRPC and grpc-web, validating the Prometheus
+  exposition format, the JSON bodies, 404 routing, the config
+  kill-switch, and keep-alive reuse (the endpoints ride the grpc-web
+  HTTP/1 loop, so they inherit its connection accounting).
+"""
+
+import asyncio
+import itertools
+import json
+import math
+import threading
+
+import pytest
+
+from at2_node_tpu.client import Client
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.net.peers import Peer
+from at2_node_tpu.node.config import Config, ObservabilityConfig
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.obs import (
+    STAGES,
+    Counter,
+    CounterGroup,
+    Gauge,
+    Histogram,
+    Registry,
+    TxTrace,
+)
+
+_ports = itertools.count(25600)
+
+TICK = 0.1
+TIMEOUT = 10.0
+
+
+def make_configs(n, **overrides):
+    cfgs = [
+        Config(
+            node_address=f"127.0.0.1:{next(_ports)}",
+            rpc_address=f"127.0.0.1:{next(_ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+            **overrides,
+        )
+        for _ in range(n)
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.nodes = [
+            Peer(o.node_address, o.network_key.public, o.sign_key.public)
+            for j, o in enumerate(cfgs)
+            if j != i
+        ]
+    return cfgs
+
+
+# ---------------------------------------------------------------- registry
+
+
+class TestInstruments:
+    def test_counter_monotonic(self):
+        c = Counter("events")
+        c.inc()
+        c.inc(41)
+        assert c.value == 42
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        with pytest.raises(ValueError):
+            c.set(41)  # set() exists for CounterGroup but stays monotonic
+        c.set(50)
+        assert c.value == 50
+
+    def test_gauge_set_and_fn(self):
+        g = Gauge("depth")
+        g.set(7)
+        assert g.value == 7
+        backing = [3]
+        g2 = Gauge("lazy", fn=lambda: backing[0])
+        assert g2.value == 3 and isinstance(g2.value, int)
+        backing[0] = 9
+        assert g2.value == 9
+        with pytest.raises(RuntimeError):
+            g2.set(1)  # callback-backed gauges are read-only
+
+    def test_gauge_fn_exception_reads_zero(self):
+        def boom():
+            raise RuntimeError("dead component")
+
+        g = Gauge("broken", fn=boom)
+        assert g.value == 0.0  # a dead provider must not take stats down
+
+    def test_histogram_exact_count_sum_max(self):
+        h = Histogram("lat")
+        for v in (0.001, 0.002, 0.004, 0.100):
+            h.observe(v)
+        h.observe(-1.0)  # negative (clock skew): dropped
+        h.observe(float("nan"))  # dropped
+        snap = h.snapshot()
+        assert snap["count"] == 4
+        assert snap["sum_ms"] == pytest.approx(107.0, abs=0.01)
+        assert snap["max_ms"] == pytest.approx(100.0, abs=0.01)
+
+    def test_histogram_bucket_math(self):
+        # bounds 1,2,4: values land in the right bucket, cumulative
+        # counts are monotone, +Inf equals the total count
+        h = Histogram("b", bounds=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.0, 1.5, 3.0, 100.0):
+            h.observe(v)
+        pairs, total, count = h.buckets()
+        assert [(le, c) for le, c in pairs] == [
+            (1.0, 2),  # 0.5, 1.0 (le is inclusive)
+            (2.0, 3),  # + 1.5
+            (4.0, 4),  # + 3.0
+            (math.inf, 5),  # + 100.0 overflow
+        ]
+        assert count == 5 and total == pytest.approx(106.0)
+
+    def test_histogram_percentiles_interpolated_and_capped(self):
+        h = Histogram("p", bounds=(1.0, 2.0, 4.0, 8.0))
+        for _ in range(100):
+            h.observe(3.0)
+        snap = h.snapshot()
+        # all mass in one bucket: percentiles interpolate inside (2,3]
+        # (capped at the observed max), so p50 < p99 <= max
+        assert 2000.0 < snap["p50_ms"] <= 3000.0
+        assert snap["p50_ms"] < snap["p99_ms"] <= snap["max_ms"]
+
+    def test_histogram_rejects_bad_bounds(self):
+        with pytest.raises(ValueError):
+            Histogram("bad", bounds=(2.0, 1.0))
+
+    def test_counter_group_dict_compat(self):
+        r = Registry()
+        g = r.counter_group(("rx", "tx"))
+        g["rx"] += 1  # the migrated call-site surface
+        g["rx"] += 2
+        assert g["rx"] == 3 and g["tx"] == 0
+        assert "rx" in g and "nope" not in g
+        assert sorted(g.keys()) == ["rx", "tx"]
+        assert dict(g.items()) == {"rx": 3, "tx": 0}
+        assert g.as_dict() == {"rx": 3, "tx": 0}
+        assert g.get("nope", 7) == 7
+        assert len(g) == 2 and set(g) == {"rx", "tx"}
+        with pytest.raises(KeyError):
+            g["typo"] += 1  # fixed key set, like the old literal dicts
+
+    def test_registry_get_or_create_and_kind_check(self):
+        r = Registry()
+        assert r.counter("x") is r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")  # same name, different kind
+
+    def test_registry_snapshot_merges_providers(self):
+        r = Registry()
+        r.counter("a").inc(2)
+        r.histogram("h").observe(0.001)
+        r.register_provider("vrf_", lambda: {"batches": 5})
+        r.register_provider("dead_", lambda: 1 / 0)  # swallowed
+        snap = r.snapshot()
+        assert snap["a"] == 2
+        assert snap["vrf_batches"] == 5
+        assert snap["h_count"] == 1 and "h_p99_ms" in snap
+        assert not any(k.startswith("dead_") for k in snap)
+
+    def test_prometheus_exposition_format(self):
+        r = Registry()
+        r.counter("commits", "total commits").inc(3)
+        r.gauge("depth").set(2)
+        r.histogram("lat", bounds=(0.001, 0.01)).observe(0.005)
+        r.register_provider("vrf_", lambda: {"occ": 0.5, "skip": "str"})
+        text = r.render_prometheus()
+        assert text.endswith("\n")
+        lines = text.splitlines()
+        assert "# TYPE at2_commits_total counter" in lines
+        assert "at2_commits_total 3" in lines
+        assert "at2_depth 2" in lines
+        assert '''at2_lat_seconds_bucket{le="0.001"} 0''' in lines
+        assert '''at2_lat_seconds_bucket{le="+Inf"} 1''' in lines
+        assert "at2_lat_seconds_count 1" in lines
+        assert "at2_vrf_occ 0.5" in lines
+        assert not any("skip" in ln for ln in lines)  # non-numeric dropped
+        # every sample line is `name{labels}? value` with a float value
+        for ln in lines:
+            if ln.startswith("#"):
+                continue
+            name, _, value = ln.rpartition(" ")
+            assert name and float(value) is not None
+
+
+class TestConcurrency:
+    def test_threaded_counter_and_histogram_exact(self):
+        # the verifier contract: worker threads bump instruments while
+        # the event loop reads them — totals must come out exact
+        r = Registry()
+        c = r.counter("hits")
+        h = r.histogram("lat")
+        n_threads, per_thread = 8, 5000
+
+        def worker():
+            for _ in range(per_thread):
+                c.inc()
+                h.observe(0.001)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * per_thread
+        assert h.snapshot()["count"] == n_threads * per_thread
+
+    async def test_asyncio_tasks_counter_exact(self):
+        r = Registry()
+        c = r.counter("ticks")
+
+        async def bump():
+            for _ in range(1000):
+                c.inc()
+                if _ % 100 == 0:
+                    await asyncio.sleep(0)  # interleave tasks
+
+        await asyncio.gather(*(bump() for _ in range(10)))
+        assert c.value == 10_000
+
+
+# ----------------------------------------------------------------- TxTrace
+
+
+class TestTxTrace:
+    def test_full_lifecycle_feeds_histograms(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=1)
+        key = (b"s" * 32, 1)
+        tr.begin(key, now=100.0)
+        for i, stage in enumerate(STAGES[1:], start=1):
+            tr.stamp(key, stage, now=100.0 + i * 0.01)
+        assert tr.live == 0  # committed removes the live record
+        snap = tr.snapshot()
+        for stage in STAGES[1:]:
+            assert snap[f"ingress_to_{stage}"]["count"] == 1
+        assert snap[f"ingress_to_{STAGES[-1]}"]["max_ms"] == pytest.approx(
+            50.0, abs=0.5
+        )
+        stats = r.snapshot()
+        assert stats["tx_traced"] == 1
+        assert stats["tx_trace_completed"] == 1
+
+    def test_sampling_every_nth(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=3)
+        for seq in range(1, 10):  # 9 ingresses -> 3 traced
+            tr.begin((b"s" * 32, seq))
+        assert r.counter("tx_traced").value == 3
+
+    def test_disabled_traces_nothing(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=0)
+        assert not tr.enabled
+        tr.begin((b"s" * 32, 1))
+        assert tr.live == 0 and r.counter("tx_traced").value == 0
+
+    def test_cap_evicts_oldest(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=1, cap=4)
+        for seq in range(1, 7):
+            tr.begin((b"s" * 32, seq))
+        assert tr.live == 4
+        assert r.counter("tx_trace_evicted").value == 2
+        # the evicted (oldest) trace no longer stamps
+        tr.stamp((b"s" * 32, 1), "committed")
+        assert r.counter("tx_trace_completed").value == 0
+
+    def test_stamps_idempotent_and_order_tolerant(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=1)
+        key = (b"s" * 32, 1)
+        tr.begin(key, now=0.0)
+        tr.stamp(key, "delivered", now=1.0)
+        tr.stamp(key, "echoed", now=2.0)  # backwards: ignored
+        tr.stamp(key, "delivered", now=3.0)  # duplicate: ignored
+        snap = tr.snapshot()
+        assert snap["ingress_to_delivered"]["count"] == 1
+        assert snap["ingress_to_echoed"]["count"] == 0
+        tr.stamp((b"x" * 32, 9), "committed")  # untraced key: no-op
+        assert r.counter("tx_trace_completed").value == 0
+
+    def test_bad_params_rejected(self):
+        r = Registry()
+        with pytest.raises(ValueError):
+            TxTrace(r, sample_every=-1)
+        with pytest.raises(ValueError):
+            TxTrace(r, cap=0)
+
+
+# ----------------------------------------------------- endpoints over mux
+
+
+async def _http_get(reader, writer, path, keep=False):
+    """One GET on an open connection; returns (status, headers, body).
+    Reads exactly Content-Length so the connection survives keep-alive."""
+    conn = "keep-alive" if keep else "close"
+    writer.write(
+        f"GET {path} HTTP/1.1\r\nHost: n\r\nConnection: {conn}\r\n\r\n".encode()
+    )
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for ln in lines[1:]:
+        if ":" in ln:
+            k, v = ln.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    body = await reader.readexactly(int(headers["content-length"]))
+    return status, headers, body
+
+
+async def _get(addr, path):
+    host, _, port = addr.rpartition(":")
+    reader, writer = await asyncio.open_connection(host, int(port))
+    try:
+        return await _http_get(reader, writer, path)
+    finally:
+        writer.close()
+
+
+class _Node:
+    def __init__(self, **overrides):
+        self.config = make_configs(1, **overrides)[0]
+
+    async def __aenter__(self):
+        self.service = await Service.start(self.config)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.service.close()
+
+
+class TestEndpoints:
+    async def test_metrics_healthz_statusz_after_commit(self):
+        async with _Node() as node:
+            addr = node.config.rpc_address
+            async with Client(f"http://{addr}") as client:
+                sender, recipient = SignKeyPair.random(), SignKeyPair.random()
+                await client.send_asset(sender, 1, recipient.public, 5)
+                deadline = asyncio.get_event_loop().time() + TIMEOUT
+                while await client.get_last_sequence(sender.public) != 1:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(TICK)
+
+            # /metrics: Prometheus text exposition on the public RPC port
+            status, headers, body = await _get(addr, "/metrics")
+            assert status == 200
+            assert headers["content-type"].startswith("text/plain")
+            text = body.decode()
+            lines = text.splitlines()
+            assert "at2_committed 1" in lines
+            assert any(
+                ln.startswith("# TYPE at2_tx_ingress_to_committed_seconds")
+                for ln in lines
+            )
+            # bucket series are cumulative and close with +Inf == count
+            buckets = [
+                ln for ln in lines
+                if ln.startswith("at2_tx_ingress_to_committed_seconds_bucket")
+            ]
+            counts = [int(ln.rpartition(" ")[2]) for ln in buckets]
+            assert counts == sorted(counts) and counts[-1] == 1
+            assert 'le="+Inf"' in buckets[-1]
+            for ln in lines:  # every sample parses as `name value`
+                if not ln.startswith("#"):
+                    float(ln.rpartition(" ")[2])
+
+            # /healthz: liveness + quorum verdict (single node: trivially ok)
+            status, headers, body = await _get(addr, "/healthz")
+            assert status == 200
+            health = json.loads(body)
+            assert health["status"] == "ok"
+            assert health["committed"] == 1
+
+            # /statusz: the operator JSON the top.py dashboard polls
+            status, headers, body = await _get(addr, "/statusz")
+            assert status == 200
+            assert headers["content-type"].startswith("application/json")
+            sz = json.loads(body)
+            assert set(sz) >= {
+                "node", "rpc_address", "health", "stats",
+                "tx_lifecycle", "verifier_stages",
+            }
+            life = sz["tx_lifecycle"]["ingress_to_committed"]
+            assert life["count"] == 1 and life["p99_ms"] > 0.0
+            assert sz["stats"]["committed"] == 1
+
+            # unknown GET path routes to 404, connection still usable
+            status, _, body = await _get(addr, "/nope")
+            assert status == 404 and body == b"not found"
+
+    async def test_keep_alive_reuses_one_connection(self):
+        async with _Node() as node:
+            addr = node.config.rpc_address
+            host, _, port = addr.rpartition(":")
+            reader, writer = await asyncio.open_connection(host, int(port))
+            try:
+                for path in ("/healthz", "/metrics", "/statusz"):
+                    status, headers, _ = await _http_get(
+                        reader, writer, path, keep=True
+                    )
+                    assert status == 200
+                    assert headers["connection"] == "keep-alive"
+            finally:
+                writer.close()
+
+    async def test_endpoints_disabled_by_config(self):
+        async with _Node(
+            observability=ObservabilityConfig(endpoints=False)
+        ) as node:
+            for path in ("/metrics", "/healthz", "/statusz"):
+                status, _, _ = await _get(node.config.rpc_address, path)
+                assert status == 404
+
+    async def test_snapshot_stats_key_set_stable(self):
+        # the registry view must not grow/shrink keys between scrapes
+        # (dashboards and the bench JSON diff on the key set)
+        async with _Node() as node:
+            first = set(node.service.snapshot_stats())
+            async with Client(f"http://{node.config.rpc_address}") as client:
+                sender = SignKeyPair.random()
+                await client.send_asset(
+                    sender, 1, SignKeyPair.random().public, 5
+                )
+                deadline = asyncio.get_event_loop().time() + TIMEOUT
+                while await client.get_last_sequence(sender.public) != 1:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(TICK)
+            after = set(node.service.snapshot_stats())
+            assert first == after
+            # the legacy ad-hoc dict keys all survived the migration
+            assert after >= {
+                "committed", "pending", "history_retained",
+                "catchup_served", "rejected_at_ingress",
+            }
+            assert "tx_ingress_to_committed_p50_ms" in after
