@@ -83,7 +83,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from collections import defaultdict
 from typing import Dict, Optional, Set, Tuple
 
@@ -322,8 +321,8 @@ class _BatchState:
         "nbits",
     )
 
-    def __init__(self) -> None:
-        self.created = time.monotonic()
+    def __init__(self, now: float) -> None:
+        self.created = now
         self.content_requested_at = 0.0
         self.retransmitted_at = 0.0  # last stalled-slot retransmission
         self.helped_at: Dict[bytes, float] = {}  # per-peer help pacing
@@ -374,8 +373,8 @@ class _SlotState:
         "helped_at",
     )
 
-    def __init__(self) -> None:
-        self.created = time.monotonic()
+    def __init__(self, now: float) -> None:
+        self.created = now
         self.content_requested_at = 0.0  # last pull request, 0 = never
         self.retransmitted_at = 0.0  # last stalled-slot retransmission
         self.helped_at: Dict[bytes, float] = {}  # per-peer help pacing
@@ -408,10 +407,14 @@ class Broadcast:
         workers: int = 16,
         registry=None,
         trace=None,
+        clock=None,
     ) -> None:
+        from ..clock import SYSTEM_CLOCK
+
         self.keypair = keypair
         self.mesh = mesh
         self.verifier = verifier
+        self.clock = SYSTEM_CLOCK if clock is None else clock
         n_peers = len(mesh.peers)
         # Reference parity: every threshold defaults to the peer count
         # (rpc.rs:112-120); configurable so f>0 setups are testable
@@ -565,8 +568,8 @@ class Broadcast:
         drive stalled-slot recovery (budgeted retransmission + the
         catchup-plane stall signal)."""
         while True:
-            await asyncio.sleep(GC_INTERVAL)
-            now = time.monotonic()
+            await self.clock.sleep(GC_INTERVAL)
+            now = self.clock.monotonic()
             budget = RETRANSMIT_BUDGET_PER_PASS
             stalled_past_horizon = False
             for slot in list(self._slots):
@@ -736,14 +739,14 @@ class Broadcast:
         """Targeted repair: send our content copy + own attestations for
         a DELIVERED slot directly to the peer whose duplicate attestation
         marked it as stalled (see _pre_attestation)."""
-        if peer is not None and self._help_paced(state, peer, time.monotonic()):
+        if peer is not None and self._help_paced(state, peer, self.clock.monotonic()):
             self._resend_slot(slot, state, peer)
 
     def _help_batch_straggler(
         self, peer: Optional[Peer], slot, state: _BatchState
     ) -> None:
         """Batch-plane twin of :meth:`_help_straggler`."""
-        if peer is not None and self._help_paced(state, peer, time.monotonic()):
+        if peer is not None and self._help_paced(state, peer, self.clock.monotonic()):
             self._resend_batch_slot(slot, state, peer)
 
     def _retransmit_slot(self, slot: Slot, state: _SlotState, now: float) -> bool:
@@ -1102,7 +1105,7 @@ class Broadcast:
         """Pull a ready-quorate slot's missing payload from its Ready voters
         (they either hold the content or know who gossiped it; falls back to
         all peers when no voter maps to a known peer)."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         if now - state.content_requested_at < REQUEST_RETRY:
             return
         state.content_requested_at = now
@@ -1122,7 +1125,7 @@ class Broadcast:
     def _new_or_existing_slot(self, slot: Slot) -> _SlotState:
         state = self._slots.get(slot)
         if state is None:
-            state = self._slots[slot] = _SlotState()
+            state = self._slots[slot] = _SlotState(self.clock.monotonic())
             self._undelivered += 1
         return state
 
@@ -1143,7 +1146,7 @@ class Broadcast:
     def _new_or_existing_batch_slot(self, slot) -> _BatchState:
         state = self._batch_slots.get(slot)
         if state is None:
-            state = self._batch_slots[slot] = _BatchState()
+            state = self._batch_slots[slot] = _BatchState(self.clock.monotonic())
             self._undelivered += 1
         return state
 
@@ -1568,7 +1571,7 @@ class Broadcast:
     def _request_batch_content(
         self, slot, state: _BatchState, chash: bytes
     ) -> None:
-        now = time.monotonic()
+        now = self.clock.monotonic()
         if now - state.content_requested_at < REQUEST_RETRY:
             return
         state.content_requested_at = now

@@ -1,0 +1,44 @@
+"""The clock seam: one injectable time source for every timed component.
+
+The broadcast stack, mesh, service, and batch verifier used to call
+`time.monotonic()` / `asyncio.sleep()` directly, which welds their timer
+semantics to the wall clock and makes adversarial-schedule testing cost
+real seconds. Every timed component now takes an optional ``clock``
+(defaulting to :data:`SYSTEM_CLOCK`, which preserves the exact previous
+behavior), and the deterministic simulator (`at2_node_tpu.sim`) injects
+a virtual clock bound to its discrete-event scheduler.
+
+Three operations cover every call site in the tree:
+
+* ``monotonic()`` — interval timestamps (slot ages, retransmit pacing,
+  token-bucket refills, pipeline latency stamps);
+* ``wall()``     — wall-clock reads whose only job is uniqueness across
+  restarts (the ingress batcher's batch_seq epoch);
+* ``sleep(dt)``  — cooperative delays (GC ticks, redial backoff,
+  catchup windows, flush timers).
+
+Production code must route timed waits through these instead of
+`time.monotonic` / `time.time` / `asyncio.sleep` so the simulator's
+virtual time covers them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class SystemClock:
+    """Real time: the default for every production component."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def wall(self) -> float:
+        return time.time()
+
+    async def sleep(self, delay: float) -> None:
+        await asyncio.sleep(delay)
+
+
+SYSTEM_CLOCK = SystemClock()

@@ -20,7 +20,6 @@ config addition).
 from __future__ import annotations
 
 import asyncio
-import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import List, Protocol, Sequence, Tuple
@@ -196,9 +195,13 @@ class TpuBatchVerifier:
         max_delay: float = 0.002,
         buckets: Sequence[int] | None = None,
         max_queue: int | None = None,
+        clock=None,
     ) -> None:
+        from ..clock import SYSTEM_CLOCK
+
         self.batch_size = batch_size
         self.max_delay = max_delay
+        self._clock = SYSTEM_CLOCK if clock is None else clock
         if buckets is None:
             # One bucket == one compiled program: a flush never exceeds
             # batch_size, so padding to it keeps every dispatch the same
@@ -346,7 +349,7 @@ class TpuBatchVerifier:
 
     def _enqueue_chunk(self, items, sink: _ChunkSink) -> None:
         was_empty = not self._queue
-        now = time.monotonic()
+        now = self._clock.monotonic()
         append = self._queue.append
         for idx, (pk, msg, sig) in enumerate(items):
             append(_Pending(pk, msg, sig, sink, idx, now))
@@ -447,11 +450,12 @@ class TpuBatchVerifier:
             while (
                 len(self._queue) < self.batch_size
                 and self._queue
-                and (time.monotonic() - self._queue[0].enqueued_at) < self.max_delay
+                and (self._clock.monotonic() - self._queue[0].enqueued_at)
+                < self.max_delay
             ):
                 self._wakeup.clear()
                 remaining = self.max_delay - (
-                    time.monotonic() - self._queue[0].enqueued_at
+                    self._clock.monotonic() - self._queue[0].enqueued_at
                 )
                 try:
                     await asyncio.wait_for(
@@ -586,23 +590,23 @@ class TpuBatchVerifier:
         # BEFORE the depth gate — waiting for an in-flight slot is queue
         # time from the caller's perspective, exactly what the admission
         # path's latency budget pays
-        self.h_queue_wait.observe(time.monotonic() - batch[0].enqueued_at)
+        self.h_queue_wait.observe(self._clock.monotonic() - batch[0].enqueued_at)
         await self._inflight.acquire()
         # clock starts AFTER the depth gate: avg/last_dispatch_ms measure
         # one batch's prep->results pipeline latency, not queue wait
-        t0 = time.monotonic()
+        t0 = self._clock.monotonic()
         try:
             if self._staged_overrides_consistent():
                 prepared = await loop.run_in_executor(
                     self._prep_pool, self._prep, pks, msgs, sigs, bucket
                 )
-                t1 = time.monotonic()
+                t1 = self._clock.monotonic()
                 self.total_prep_s += t1 - t0
                 self.h_prep.observe(t1 - t0)
                 handle = await loop.run_in_executor(
                     self._device_pool, self._launch, prepared
                 )
-                t2 = time.monotonic()
+                t2 = self._clock.monotonic()
                 self.total_launch_s += t2 - t1
                 self.h_launch.observe(t2 - t1)
                 finish = loop.run_in_executor(
@@ -626,7 +630,7 @@ class TpuBatchVerifier:
         task.add_done_callback(self._completions.discard)
 
     async def _complete(self, batch, bucket, finish, t0) -> None:
-        t_fin = time.monotonic()
+        t_fin = self._clock.monotonic()
         try:
             results = await finish
         except BaseException as exc:
@@ -636,7 +640,7 @@ class TpuBatchVerifier:
             return
         finally:
             self._inflight.release()
-        t_done = time.monotonic()
+        t_done = self._clock.monotonic()
         self.total_finish_s += t_done - t_fin
         self.h_finish.observe(t_done - t_fin)
         self.last_dispatch_s = t_done - t0

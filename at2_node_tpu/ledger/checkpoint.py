@@ -49,6 +49,20 @@ def write_atomic(path: str, doc: dict) -> None:
             fp.flush()
             os.fsync(fp.fileno())
         os.replace(tmp, path)
+        # fsync the containing directory too: the rename itself must be
+        # durable, or a crash can leave the old (or no) checkpoint after
+        # the caller was told the save completed
+        try:
+            dfd = os.open(directory, os.O_RDONLY)
+        except OSError:
+            pass  # platform/filesystem without directory fds
+        else:
+            try:
+                os.fsync(dfd)
+            except OSError:
+                pass
+            finally:
+                os.close(dfd)
     except BaseException:
         try:
             os.unlink(tmp)

@@ -79,9 +79,13 @@ class Mesh:
         keypair: ExchangeKeyPair,
         peers: Iterable[Peer],
         on_frame: Callable[[Peer, bytes], Awaitable[None]],
+        clock=None,
     ) -> None:
+        from ..clock import SYSTEM_CLOCK
+
         self.listen_addr = listen_addr
         self.keypair = keypair
+        self.clock = SYSTEM_CLOCK if clock is None else clock
         self.peers = [p for p in peers if p.exchange_public != keypair.public]
         self.by_exchange: Dict[bytes, Peer] = {
             p.exchange_public: p for p in self.peers
@@ -226,7 +230,7 @@ class Mesh:
                 channel = await transport.connect(host, port, self.keypair)
             except (OSError, transport.HandshakeError, asyncio.TimeoutError):
                 self.dial_failures += 1
-                await asyncio.sleep(backoff)
+                await self.clock.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
                 continue
             if channel.peer_public != peer.exchange_public:
@@ -237,7 +241,7 @@ class Mesh:
                 )
                 self.dial_failures += 1
                 channel.close()
-                await asyncio.sleep(backoff)
+                await self.clock.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
                 continue
             backoff = 0.1

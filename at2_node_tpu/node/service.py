@@ -29,7 +29,6 @@ import heapq
 import json
 import logging
 import secrets
-import time
 from typing import Dict, List, Optional, Set, Tuple
 
 import grpc
@@ -139,8 +138,11 @@ def _enable_stats_logging() -> None:
 class Service(At2Servicer):
     """One AT2 node. `await Service.start(config)`, then `serve_forever`."""
 
-    def __init__(self, config: Config) -> None:
+    def __init__(self, config: Config, clock=None) -> None:
+        from ..clock import SYSTEM_CLOCK
+
         self.config = config
+        self.clock = SYSTEM_CLOCK if clock is None else clock
         self.accounts = Accounts()
         self.recent = RecentTransactions()
         # Per-Service metrics registry (obs/registry.py): every counter,
@@ -155,7 +157,7 @@ class Service(At2Servicer):
             sample_every=obs.trace_sample,
             cap=obs.trace_cap,
         )
-        self._started_at = time.monotonic()
+        self._started_at = self.clock.monotonic()
         self.verifier: Optional[Verifier] = None
         self.mesh: Optional[Mesh] = None
         self.broadcast: Optional[Broadcast] = None
@@ -213,7 +215,10 @@ class Service(At2Servicer):
         # sequence gate is what orders transfers).
         self._batch_buf: List[Payload] = []
         self._batch_flush_task: Optional[asyncio.Task] = None
-        self._batch_seq = int(time.time() * 1000) << 20
+        self._batch_seq = int(self.clock.wall() * 1000) << 20
+        # catchup session nonce source: secrets by default; the simulator
+        # swaps in a seeded rng so session frames replay bit-identically
+        self._nonce_bits = secrets.randbits
         # ingress admission (config [admission]): per-source token
         # buckets charged ONLY for entries that fail pre-verification —
         # source -> [tokens, refill_stamp]
@@ -250,12 +255,26 @@ class Service(At2Servicer):
     # -- lifecycle --------------------------------------------------------
 
     @staticmethod
-    async def start(config: Config, verifier: Optional[Verifier] = None) -> "Service":
+    async def start(
+        config: Config,
+        verifier: Optional[Verifier] = None,
+        *,
+        clock=None,
+        mesh_factory=None,
+        serve_rpc: bool = True,
+    ) -> "Service":
         """Bring up one node. ``verifier`` injects a SHARED verifier (the
         BASELINE config-5 shape: many nodes feeding one device pool —
         `parallel.pool.PoolVerifier`); the caller keeps ownership and
-        closes it after every sharing node is down."""
-        service = Service(config)
+        closes it after every sharing node is down.
+
+        ``clock`` / ``mesh_factory`` / ``serve_rpc`` are the simulator's
+        seams (at2_node_tpu/sim): an injected virtual clock, a transport
+        factory ``(config, on_frame) -> Mesh``-compatible object replacing
+        the real socket mesh, and a switch to skip the gRPC/PortMux
+        surface (the sim drives the handlers directly). Defaults preserve
+        production behavior exactly."""
+        service = Service(config, clock=clock)
         if verifier is not None:
             service.verifier = verifier
             service._owns_verifier = False
@@ -287,12 +306,17 @@ class Service(At2Servicer):
         # releases the warmed-up verifier, mesh tasks, and background
         # loops instead of leaking them.
         try:
-            service.mesh = Mesh(
-                config.node_address,
-                config.network_key,
-                config.nodes,
-                on_frame=lambda peer, frame: service.broadcast.on_frame(peer, frame),
-            )
+            on_frame = lambda peer, frame: service.broadcast.on_frame(peer, frame)  # noqa: E731
+            if mesh_factory is not None:
+                service.mesh = mesh_factory(config, on_frame)
+            else:
+                service.mesh = Mesh(
+                    config.node_address,
+                    config.network_key,
+                    config.nodes,
+                    on_frame=on_frame,
+                    clock=service.clock,
+                )
             service.broadcast = Broadcast(
                 config.sign_key,
                 service.mesh,
@@ -301,6 +325,7 @@ class Service(At2Servicer):
                 ready_threshold=config.ready_threshold,
                 registry=service.registry,
                 trace=service.tx_trace,
+                clock=service.clock,
             )
             service.broadcast.catchup_handler = service._on_catchup
             if config.catchup.enabled:
@@ -343,26 +368,27 @@ class Service(At2Servicer):
                 jax.profiler.start_trace(obs.profile_dir)
                 service._profiling = True
 
-            # The public RPC port is a mux (reference parity: tonic serves
-            # native gRPC AND grpc-web/HTTP1/CORS on one port, main.rs:110-114):
-            # grpc.aio binds an internal loopback port; the mux splices HTTP/2
-            # clients to it and answers grpc-web itself.
-            server = grpc.aio.server()
-            add_to_server(service, server)
-            # assigned BEFORE start: if start() (or anything after) raises,
-            # the guard's close() must stop this server, not leak its port
-            service._grpc_server = server
-            internal_port = server.add_insecure_port("127.0.0.1:0")
-            if internal_port == 0:
-                raise OSError("cannot bind internal grpc port")
-            await server.start()
-            service._mux = PortMux(config.rpc_address, internal_port, service)
-            try:
-                await service._mux.start()
-            except OSError as exc:
-                raise OSError(
-                    f"cannot bind rpc address {config.rpc_address}"
-                ) from exc
+            if serve_rpc:
+                # The public RPC port is a mux (reference parity: tonic serves
+                # native gRPC AND grpc-web/HTTP1/CORS on one port, main.rs:110-114):
+                # grpc.aio binds an internal loopback port; the mux splices HTTP/2
+                # clients to it and answers grpc-web itself.
+                server = grpc.aio.server()
+                add_to_server(service, server)
+                # assigned BEFORE start: if start() (or anything after) raises,
+                # the guard's close() must stop this server, not leak its port
+                service._grpc_server = server
+                internal_port = server.add_insecure_port("127.0.0.1:0")
+                if internal_port == 0:
+                    raise OSError("cannot bind internal grpc port")
+                await server.start()
+                service._mux = PortMux(config.rpc_address, internal_port, service)
+                try:
+                    await service._mux.start()
+                except OSError as exc:
+                    raise OSError(
+                        f"cannot bind rpc address {config.rpc_address}"
+                    ) from exc
         except BaseException:
             await service.close()
             raise
@@ -443,7 +469,7 @@ class Service(At2Servicer):
         # commit them before the final snapshot. Crash shutdown remains
         # best-effort by design (ledger/checkpoint.py docstring).
         if self.broadcast is not None:
-            now = time.monotonic()
+            now = self.clock.monotonic()
             while True:
                 try:
                     p = self.broadcast.delivered.get_nowait()
@@ -466,7 +492,7 @@ class Service(At2Servicer):
 
     async def _checkpoint_loop(self, path: str, interval: float) -> None:
         while True:
-            await asyncio.sleep(interval)
+            await self.clock.sleep(interval)
             try:
                 await ckpt.save(path, self.accounts, self.recent)
             except OSError:
@@ -489,7 +515,7 @@ class Service(At2Servicer):
 
     async def _stats_loop(self, interval: float) -> None:
         while True:
-            await asyncio.sleep(interval)
+            await self.clock.sleep(interval)
             snap = self.snapshot_stats()
             # one JSON object per line, keys sorted: machine-parseable
             # (jq / pandas) where the old space-joined k=v repr was not
@@ -531,7 +557,7 @@ class Service(At2Servicer):
         the node is not shutting down, enough peer channels are up that
         a broadcast can reach its ready quorum, and no pending payload
         has been gap-blocked past the catchup trigger horizon."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         peers_total = len(self.config.nodes)
         channels = 0
         if self.mesh is not None:
@@ -614,7 +640,7 @@ class Service(At2Servicer):
                     batch.append(queue.get_nowait())
                 except asyncio.QueueEmpty:
                     break
-            now = time.monotonic()
+            now = self.clock.monotonic()
             for p in batch:
                 self._push_pending(p, now)
             await self._drain_to_fixpoint()
@@ -641,7 +667,7 @@ class Service(At2Servicer):
             self._heap = []
             before = len(batch)
             batch.sort()
-            now = time.monotonic()
+            now = self.clock.monotonic()
             catchup_keys = self._catchup_keys
 
             def _apply_pass(accounts) -> tuple:
@@ -838,7 +864,7 @@ class Service(At2Servicer):
     def _serve_allow(self, peer: Peer, kind: str, cost: int, cap: int) -> bool:
         """1-second token window per (peer, kind); drops beyond the cap
         (the requester's session loop simply retries next second)."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         budget = self._serve_budget.setdefault(
             (peer.sign_public, kind), [now, 0]
         )
@@ -946,7 +972,7 @@ class Service(At2Servicer):
         stop producing COMMIT progress back off exponentially."""
         cfg = self.config.catchup
         if initial_delay:
-            await asyncio.sleep(initial_delay)
+            await self.clock.sleep(initial_delay)
         attempts = 0
         no_progress = 0  # consecutive sessions with no commit progress
         try:
@@ -962,7 +988,7 @@ class Service(At2Servicer):
                     applied > 0 or self._catchup_commits > commits_before
                 )
                 no_progress = 0 if progressed else no_progress + 1
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 gap_remains = any(
                     now - entry[1] > cfg.after for entry in self._heap
                 )
@@ -985,7 +1011,7 @@ class Service(At2Servicer):
                         * 2 ** (no_progress - self._CATCHUP_BACKOFF_AFTER),
                         self._CATCHUP_MAX_BACKOFF,
                     )
-                await asyncio.sleep(delay)
+                await self.clock.sleep(delay)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -998,12 +1024,12 @@ class Service(At2Servicer):
         if not peers or self._catchup_session is not None:
             return 0, 0
         quorum = self._catchup_quorum(len(peers))
-        session = _CatchupSession(secrets.randbits(64), len(peers))
+        session = _CatchupSession(self._nonce_bits(64), len(peers))
         self._catchup_session = session
         self.catchup_stats["catchup_sessions"] += 1
         try:
             self.mesh.broadcast(HistoryIndexRequest(session.nonce).encode())
-            await asyncio.sleep(cfg.window)
+            await self.clock.sleep(cfg.window)
             responses = len(session.indexes)
             local = self.accounts.frontier_nowait()
             needed: Dict[bytes, int] = {}
@@ -1018,7 +1044,7 @@ class Service(At2Servicer):
                 self.mesh.broadcast(
                     HistoryRequest(session.nonce, sender, lo, top).encode()
                 )
-            await asyncio.sleep(cfg.window)
+            await self.clock.sleep(cfg.window)
             candidates = [
                 payload
                 for vote_key, payload in session.payloads.items()
@@ -1032,7 +1058,7 @@ class Service(At2Servicer):
                     for p in candidates
                 ]
             )
-            now = time.monotonic()
+            now = self.clock.monotonic()
             frontier = self.accounts.frontier_nowait()
             applied = 0
             for p, ok in zip(candidates, results):
@@ -1088,7 +1114,7 @@ class Service(At2Servicer):
         # completing are atomic (no await between them, single event
         # loop), so nothing can slip in after the last check.
         while True:
-            await asyncio.sleep(window)
+            await self.clock.sleep(window)
             await self._flush_batch()
             if not self._batch_buf:
                 return
@@ -1183,7 +1209,7 @@ class Service(At2Servicer):
             return
         peer_fn = getattr(context, "peer", None)
         source = peer_fn() if callable(peer_fn) else "local"
-        bucket = self._admission_refill(source, time.monotonic())
+        bucket = self._admission_refill(source, self.clock.monotonic())
         if bucket[0] < 1.0:
             self.admission_stats["admission_throttled"] += 1
             await context.abort(
@@ -1209,13 +1235,13 @@ class Service(At2Servicer):
 
     def _trace_begin(self, payloads: List[Payload]) -> None:
         if self.tx_trace.enabled:
-            now = time.monotonic()
+            now = self.clock.monotonic()
             for p in payloads:
                 self.tx_trace.begin((p.sender, p.sequence), now)
 
     def _trace_stamp(self, payloads: List[Payload], stage: str) -> None:
         if self.tx_trace.enabled:
-            now = time.monotonic()
+            now = self.clock.monotonic()
             for p in payloads:
                 self.tx_trace.stamp((p.sender, p.sequence), stage, now)
 

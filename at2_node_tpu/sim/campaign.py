@@ -1,0 +1,460 @@
+"""Seeded schedule search: episode generation, campaign running, exact
+replay, and greedy trace minimization.
+
+An *episode* is an explicit timed event list — client transactions,
+equivocating submissions, hostile frame salvos, partitions, and
+kind-selective drop windows — applied to a fresh :class:`SimNet` and
+run to quiescence, after which the AT2 invariants are checked. The
+event list is plain JSON data: given the same ``(seed, config,
+events)`` the episode replays bit-identically (same wire trace hash),
+which is what makes a banked failing schedule a *reproducer*, not an
+anecdote.
+
+Minimization shrinks a failing schedule the way trace-based fuzzers
+do: first the shortest failing prefix (bisection), then greedy
+single-event removal to a fixpoint. The survivor is the minimal
+schedule the invariant checker still rejects.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from .fabric import LinkModel
+from .hostile import HostileFrameGen
+from .net import SimNet, sim_client
+
+# An event is [t, kind, args-dict] — JSON-shaped on purpose (banked by
+# tools/sim_run.py, replayed byte-identically from the file).
+Event = list
+
+# frame kinds a drop window can select on (messages.py)
+_DROPPABLE_KINDS = (1, 2, 3, 9, 10, 11)
+
+
+def _seed_int(*parts) -> int:
+    h = hashlib.sha256("\x1f".join(str(p) for p in parts).encode())
+    return int.from_bytes(h.digest()[:8], "big")
+
+
+def generate_events(
+    rng: random.Random,
+    *,
+    nodes: int = 4,
+    n_clients: int = 4,
+    n_events: int = 30,
+    duration: float = 20.0,
+    hostile: bool = True,
+    faults: bool = True,
+) -> List[Event]:
+    """A random adversarial schedule: honest traffic interleaved with
+    client equivocation, hostile frame salvos, partitions (healed
+    within the episode), and kind-selective drop windows."""
+    events: List[Event] = []
+    next_seq = [1] * n_clients
+    burned: set = set()  # equivocated clients: their gate may never advance
+    for _ in range(n_events):
+        t = round(rng.uniform(0.0, duration), 3)
+        roll = rng.random()
+        usable = [c for c in range(n_clients) if c not in burned]
+        if (roll < 0.55 or not (hostile or faults)) and usable:
+            c = rng.choice(usable)
+            events.append(
+                [
+                    t,
+                    "tx",
+                    {
+                        "node": rng.randrange(nodes),
+                        "client": c,
+                        "seq": next_seq[c],
+                        "to": rng.randrange(n_clients),
+                        "amount": rng.randint(1, 50),
+                    },
+                ]
+            )
+            next_seq[c] += 1
+        elif roll < 0.62 and usable and nodes >= 2:
+            c = rng.choice(usable)
+            a, b = rng.sample(range(nodes), 2)
+            amount = rng.randint(1, 50)
+            events.append(
+                [
+                    t,
+                    "equiv",
+                    {
+                        "node_a": a,
+                        "node_b": b,
+                        "client": c,
+                        "seq": next_seq[c],
+                        "to_a": rng.randrange(n_clients),
+                        "to_b": rng.randrange(n_clients),
+                        "amount_a": amount,
+                        "amount_b": amount + 1,  # contents must differ
+                    },
+                ]
+            )
+            burned.add(c)
+        elif roll < 0.80 and hostile:
+            events.append(
+                [
+                    t,
+                    "hostile",
+                    {
+                        "targets": sorted(
+                            rng.sample(range(nodes), rng.randint(1, nodes))
+                        ),
+                        "count": rng.randint(1, 6),
+                    },
+                ]
+            )
+        elif roll < 0.90 and faults and nodes >= 2:
+            a, b = rng.sample(range(nodes), 2)
+            events.append(
+                [
+                    t,
+                    "cut",
+                    {"a": a, "b": b, "duration": round(rng.uniform(0.5, 6.0), 3)},
+                ]
+            )
+        elif faults:
+            events.append(
+                [
+                    t,
+                    "drop",
+                    {
+                        "src": rng.choice([None] + list(range(nodes))),
+                        "kinds": sorted(
+                            rng.sample(_DROPPABLE_KINDS, rng.randint(1, 3))
+                        ),
+                        "duration": round(rng.uniform(0.2, 3.0), 3),
+                    },
+                ]
+            )
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
+@dataclass
+class EpisodeResult:
+    seed: int
+    events: List[Event]
+    violations: List[str]
+    trace_hash: str
+    committed: List[int]
+    delivered: int
+    dropped: int
+    virtual_time: float
+    wall_seconds: float
+    minimized: Optional[List[Event]] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "ok": self.ok,
+            "n_events": len(self.events),
+            "violations": self.violations,
+            "trace_hash": self.trace_hash,
+            "committed": self.committed,
+            "delivered": self.delivered,
+            "dropped": self.dropped,
+            "virtual_time": self.virtual_time,
+            "wall_seconds": round(self.wall_seconds, 3),
+            "events": self.events,
+            "minimized": self.minimized,
+        }
+
+
+def _install_interposer(net: SimNet, rules: List[list]) -> None:
+    """Drop-window interposer: rules are [until_t, src_sign|None, kinds]."""
+
+    def interpose(src: bytes, dst: bytes, frame: bytes):
+        if not rules:
+            return None
+        now = net.loop.time()
+        live = [r for r in rules if r[0] >= now]
+        if len(live) != len(rules):
+            rules[:] = live
+        for _until, src_sign, kinds in rules:
+            if frame and frame[0] in kinds and (
+                src_sign is None or src_sign == src
+            ):
+                return []
+        return None
+
+    net.fabric.interposer = interpose
+
+
+def apply_events(
+    net: SimNet,
+    events: List[Event],
+    clients: List,
+    hostile_gen: Optional[HostileFrameGen],
+) -> None:
+    """Schedule every event onto the net's virtual timeline (relative to
+    now). Submissions go through the real SendAsset handler; rejections
+    (SimRpcError) are normal traffic in adversarial schedules."""
+    loop = net.loop
+    rules: List[list] = []
+    _install_interposer(net, rules)
+
+    def node_sign(i: int) -> bytes:
+        return net.configs[i].sign_key.public
+
+    def submit(node, client_i, seq, to_i, amount):
+        client = clients[client_i]
+        task = loop.create_task(
+            net.asubmit(node, client, seq, clients[to_i].public, amount)
+        )
+        net.fabric._tasks.add(task)
+        task.add_done_callback(net.fabric._tasks.discard)
+
+    for t, kind, args in events:
+        if kind == "tx":
+            loop.call_later(
+                t,
+                submit,
+                args["node"],
+                args["client"],
+                args["seq"],
+                args["to"],
+                args["amount"],
+            )
+        elif kind == "equiv":
+
+            def equiv(args=args):
+                c = clients[args["client"]]
+                for node, to_i, amount in (
+                    (args["node_a"], args["to_a"], args["amount_a"]),
+                    (args["node_b"], args["to_b"], args["amount_b"]),
+                ):
+                    task = loop.create_task(
+                        net.asubmit(
+                            node, c, args["seq"], clients[to_i].public, amount
+                        )
+                    )
+                    net.fabric._tasks.add(task)
+                    task.add_done_callback(net.fabric._tasks.discard)
+
+            loop.call_later(t, equiv)
+        elif kind == "hostile":
+            if hostile_gen is None:
+                continue
+
+            def salvo(args=args):
+                for _ in range(args["count"]):
+                    frame = hostile_gen.next_frame()
+                    for target in args["targets"]:
+                        net.fabric.inject(
+                            hostile_gen.sign.public, node_sign(target), frame
+                        )
+
+            loop.call_later(t, salvo)
+        elif kind == "cut":
+
+            def cut(args=args):
+                a, b = node_sign(args["a"]), node_sign(args["b"])
+                net.fabric.partition(a, b)
+                loop.call_later(args["duration"], net.fabric.heal, a, b)
+
+            loop.call_later(t, cut)
+        elif kind == "drop":
+
+            def drop(args=args):
+                src = (
+                    None if args["src"] is None else node_sign(args["src"])
+                )
+                rules.append(
+                    [loop.time() + args["duration"], src, set(args["kinds"])]
+                )
+
+            loop.call_later(t, drop)
+        elif kind == "inject":
+            # raw frame injection (hex), for hand-built scenarios
+            def inject(args=args):
+                frame = bytes.fromhex(args["frame"])
+                src = node_sign(args.get("src", 0))
+                if "src_hostile" in args and hostile_gen is not None:
+                    src = hostile_gen.sign.public
+                net.fabric.inject(src, node_sign(args["target"]), frame)
+
+            loop.call_later(t, inject)
+        else:
+            raise ValueError(f"unknown event kind: {kind}")
+
+
+def run_episode(
+    seed: int,
+    *,
+    nodes: int = 4,
+    f: int = 1,
+    hostile: int = 1,
+    events: Optional[List[Event]] = None,
+    n_events: int = 30,
+    duration: float = 20.0,
+    n_clients: int = 4,
+    link: Optional[LinkModel] = None,
+    settle_horizon: float = 150.0,
+    echo_threshold: Optional[int] = None,
+    ready_threshold: Optional[int] = None,
+    config_overrides: Optional[dict] = None,
+) -> EpisodeResult:
+    """One self-contained episode: fresh SimNet, (generated or given)
+    events, run + settle, invariant check, teardown. Pure in
+    ``(seed, parameters, events)``."""
+    wall0 = time.monotonic()
+    rng = random.Random(_seed_int("episode", seed))
+    net = SimNet(
+        nodes,
+        f,
+        seed,
+        hostile=hostile,
+        link=link,
+        echo_threshold=echo_threshold,
+        ready_threshold=ready_threshold,
+        **(config_overrides or {}),
+    ).start()
+    try:
+        clients = [sim_client(seed, i) for i in range(n_clients)]
+        if events is None:
+            events = generate_events(
+                rng,
+                nodes=nodes,
+                n_clients=n_clients,
+                n_events=n_events,
+                duration=duration,
+                hostile=hostile > 0,
+            )
+        hostile_gen = (
+            HostileFrameGen(
+                net.hostile_configs[0].sign_key,
+                random.Random(_seed_int("hostile", seed)),
+            )
+            if hostile > 0
+            else None
+        )
+        apply_events(net, events, clients, hostile_gen)
+        last_t = max((e[0] for e in events), default=0.0)
+        net.run_for(last_t + 1.0)
+        net.fabric.heal_all()
+        virtual = last_t + 1.0 + net.settle(horizon=settle_horizon)
+        violations = net.check_invariants()
+        return EpisodeResult(
+            seed=seed,
+            events=events,
+            violations=violations,
+            trace_hash=net.fabric.trace_hash(),
+            committed=[s.committed for s in net.services],
+            delivered=net.fabric.delivered,
+            dropped=net.fabric.dropped,
+            virtual_time=virtual,
+            wall_seconds=time.monotonic() - wall0,
+        )
+    finally:
+        net.close()
+
+
+def minimize_events(
+    events: List[Event],
+    failing: Callable[[List[Event]], bool],
+    *,
+    max_passes: int = 3,
+) -> List[Event]:
+    """Shrink a failing schedule: shortest failing prefix by bisection,
+    then greedy single-event removal to a fixpoint. ``failing`` must be
+    deterministic (replay the same seed/config with the candidate
+    list)."""
+    if not failing(events):
+        raise ValueError("schedule does not fail: nothing to minimize")
+    # 1. shortest failing prefix
+    lo, hi = 1, len(events)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if failing(events[:mid]):
+            hi = mid
+        else:
+            lo = mid + 1
+    current = list(events[:hi])
+    # 2. greedy removal to fixpoint
+    for _ in range(max_passes):
+        removed_any = False
+        i = len(current) - 1
+        while i >= 0 and len(current) > 1:
+            candidate = current[:i] + current[i + 1 :]
+            if failing(candidate):
+                current = candidate
+                removed_any = True
+            i -= 1
+        if not removed_any:
+            break
+    return current
+
+
+def run_campaign(
+    seed: int,
+    episodes: int,
+    *,
+    nodes: int = 4,
+    f: int = 1,
+    hostile: int = 1,
+    n_events: int = 30,
+    duration: float = 20.0,
+    minimize: bool = False,
+    link: Optional[LinkModel] = None,
+    progress: Optional[Callable[[int, "EpisodeResult"], None]] = None,
+) -> dict:
+    """``episodes`` independent seeded episodes; per-episode seeds derive
+    from the campaign seed, failures carry their exact replay recipe
+    (seed + event list), and the campaign hash — sha256 over the
+    episode trace hashes — is the determinism fingerprint CI compares
+    across two same-seed runs."""
+    camp_rng = random.Random(_seed_int("campaign", seed))
+    results: List[EpisodeResult] = []
+    for ep in range(episodes):
+        ep_seed = camp_rng.getrandbits(32)
+        result = run_episode(
+            ep_seed,
+            nodes=nodes,
+            f=f,
+            hostile=hostile,
+            n_events=n_events,
+            duration=duration,
+            link=link,
+        )
+        if result.violations and minimize:
+            result.minimized = minimize_events(
+                result.events,
+                lambda evs: bool(
+                    run_episode(
+                        ep_seed,
+                        nodes=nodes,
+                        f=f,
+                        hostile=hostile,
+                        events=evs,
+                        link=link,
+                    ).violations
+                ),
+            )
+        results.append(result)
+        if progress is not None:
+            progress(ep, result)
+    h = hashlib.sha256()
+    for r in results:
+        h.update(r.trace_hash.encode())
+    return {
+        "campaign_seed": seed,
+        "episodes": episodes,
+        "nodes": nodes,
+        "f": f,
+        "hostile": hostile,
+        "campaign_hash": h.hexdigest(),
+        "failures": sum(1 for r in results if not r.ok),
+        "results": [r.to_dict() for r in results],
+    }

@@ -1,0 +1,241 @@
+"""Seeded hostile-frame generation, shared by the live-socket byzantine
+fuzz campaign (tests/test_byzantine_fuzz.py) and the simulated fabric
+campaigns (`at2_node_tpu.sim.campaign`).
+
+``HostileFrameGen`` is the pure part of the fuzzer: an authenticated
+byzantine peer's frame builders — valid-but-conflicting attestations,
+batch equivocation, poison batches, oversized bitmaps, catchup-plane
+junk, truncations, verbatim replays — driven entirely by an injected
+``random.Random``. It never touches a socket; the live test wraps it
+with transport channels, the simulator feeds its frames through
+``SimFabric.inject``.
+
+Client/recipient identities are derived from the rng (not
+``SignKeyPair.random()``), so a `(seed, config)` pair fixes the entire
+hostile byte stream — the property exact replay rests on.
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+
+from ..broadcast.messages import (
+    BATCH_ECHO,
+    BATCH_READY,
+    ECHO,
+    READY,
+    Attestation,
+    BatchAttestation,
+    ContentRequest,
+    HistoryBatch,
+    HistoryIndexRequest,
+    HistoryRequest,
+    Payload,
+    TxBatch,
+)
+from ..crypto.keys import SignKeyPair
+from ..types import ThinTransaction
+
+
+def _rng_keypair(rng: random.Random) -> SignKeyPair:
+    return SignKeyPair(bytes(rng.getrandbits(8) for _ in range(32)))
+
+
+class HostileFrameGen:
+    """Authenticated byzantine peer emitting seeded random frame salvos."""
+
+    def __init__(self, sign_key: SignKeyPair, rng: random.Random):
+        self.sign = sign_key
+        self.rng = rng
+        self.sent_log = []  # replay source
+        # identities this fuzzer signs client payloads with
+        self.clients = [_rng_keypair(rng) for _ in range(3)]
+        self.recipients = [_rng_keypair(rng).public for _ in range(3)]
+        self.batches = []  # real TxBatches sent: targets for oversized bitmaps
+
+    # -- frame builders ---------------------------------------------------
+
+    def _payload(self, client, seq, recipient, amount, good_sig=True):
+        tx = ThinTransaction(recipient, amount)
+        sig = (
+            client.sign(tx.signing_bytes())
+            if good_sig
+            else bytes(self.rng.getrandbits(8) for _ in range(64))
+        )
+        return Payload(client.public, seq, tx, sig)
+
+    def _rand_payload(self):
+        rng = self.rng
+        return self._payload(
+            rng.choice(self.clients),
+            rng.randint(1, 4),
+            rng.choice(self.recipients),
+            rng.randint(1, 50),
+            good_sig=rng.random() > 0.25,
+        )
+
+    def _rand_batch(self):
+        rng = self.rng
+        entries = b"".join(
+            self._rand_payload().encode()[1:]
+            for _ in range(rng.randint(1, 6))
+        )
+        batch = TxBatch.create(self.sign, rng.randint(1, 5), entries)
+        self.batches.append(batch)
+        return batch
+
+    def _poison_batch(self):
+        """A batch GUARANTEED to carry at least one never-verifiable
+        entry among honest-looking ones — the poison-slot resolution
+        path's bread and butter (slot must retire, never stall)."""
+        rng = self.rng
+        payloads = [self._rand_payload() for _ in range(rng.randint(1, 4))]
+        payloads.insert(
+            rng.randrange(len(payloads) + 1),
+            self._payload(
+                rng.choice(self.clients),
+                rng.randint(1, 4),
+                rng.choice(self.recipients),
+                rng.randint(1, 50),
+                good_sig=False,
+            ),
+        )
+        entries = b"".join(p.encode()[1:] for p in payloads)
+        batch = TxBatch.create(self.sign, rng.randint(1, 5), entries)
+        self.batches.append(batch)
+        return batch
+
+    def _oversized_batch_attestation(self):
+        """A correctly signed attestation for a REAL previously-sent
+        batch whose bitmap claims far more entries than the batch has:
+        exercises the width clamp (phantom bits must not grow nbits or
+        spuriously quorate). Falls back to a random one before any batch
+        exists."""
+        rng = self.rng
+        if not self.batches:
+            return self._rand_batch_attestation()
+        batch = rng.choice(self.batches)
+        phase = rng.choice((BATCH_ECHO, BATCH_READY))
+        bitmap = bytes(
+            rng.getrandbits(8) | 1 for _ in range(rng.choice((16, 64, 128)))
+        )
+        sig = self.sign.sign(
+            BatchAttestation.signing_bytes(
+                phase, batch.origin, batch.batch_seq, batch.content_hash(), bitmap
+            )
+        )
+        return BatchAttestation(
+            phase,
+            self.sign.public,
+            batch.origin,
+            batch.batch_seq,
+            batch.content_hash(),
+            bitmap,
+            sig,
+        )
+
+    def _rand_attestation(self):
+        rng = self.rng
+        phase = rng.choice((ECHO, READY))
+        sender = rng.choice(self.clients).public
+        seq = rng.randint(1, 4)
+        chash = (
+            self._rand_payload().content_hash()
+            if rng.random() < 0.6
+            else bytes(rng.getrandbits(8) for _ in range(32))
+        )
+        sig = self.sign.sign(
+            Attestation.signing_bytes(phase, sender, seq, chash)
+        )
+        return Attestation(phase, self.sign.public, sender, seq, chash, sig)
+
+    def targeted_attestation(self, phase, sender, seq, chash):
+        """A correctly signed attestation for an EXACT (sender, seq,
+        content) — the building block of split-vote schedules, where the
+        hostile peer vouches for different contents to different nodes."""
+        sig = self.sign.sign(
+            Attestation.signing_bytes(phase, sender, seq, chash)
+        )
+        return Attestation(phase, self.sign.public, sender, seq, chash, sig)
+
+    def _rand_batch_attestation(self):
+        rng = self.rng
+        phase = rng.choice((BATCH_ECHO, BATCH_READY))
+        b_origin = self.sign.public
+        b_seq = rng.randint(1, 5)
+        b_hash = bytes(rng.getrandbits(8) for _ in range(32))
+        bitmap = bytes(
+            rng.getrandbits(8) for _ in range(rng.choice((1, 2, 16, 128)))
+        )
+        sig = self.sign.sign(
+            BatchAttestation.signing_bytes(phase, b_origin, b_seq, b_hash, bitmap)
+        )
+        return BatchAttestation(
+            phase, self.sign.public, b_origin, b_seq, b_hash, bitmap, sig
+        )
+
+    def _rand_catchup_junk(self):
+        rng = self.rng
+        kind = rng.randrange(4)
+        if kind == 0:
+            return HistoryIndexRequest(rng.getrandbits(64))
+        if kind == 1:
+            return HistoryRequest(
+                rng.getrandbits(64),
+                rng.choice(self.clients).public,
+                1,
+                rng.randint(1, 1 << 20),  # absurd range: server must clamp
+            )
+        if kind == 2:
+            return HistoryBatch(
+                rng.getrandbits(64),
+                tuple(self._rand_payload() for _ in range(rng.randint(1, 4))),
+            )
+        return ContentRequest(
+            rng.choice(self.clients).public,
+            rng.randint(1, 4),
+            bytes(rng.getrandbits(8) for _ in range(32)),
+        )
+
+    def _malformed(self) -> bytes:
+        rng = self.rng
+        choice = rng.randrange(4)
+        if choice == 0:  # unknown kind
+            return bytes([rng.randint(13, 255)]) + bytes(
+                rng.getrandbits(8) for _ in range(rng.randint(0, 64))
+            )
+        if choice == 1:  # truncated known message
+            full = self._rand_payload().encode()
+            return full[: rng.randint(1, len(full) - 1)]
+        if choice == 2:  # batch header with an absurd count field
+            b = bytearray(self._rand_batch().encode())
+            b[41:45] = struct.pack("<I", rng.randint(1025, 1 << 30))
+            return bytes(b)
+        # random garbage
+        return bytes(rng.getrandbits(8) for _ in range(rng.randint(1, 200)))
+
+    def next_frame(self) -> bytes:
+        rng = self.rng
+        roll = rng.random()
+        if roll < 0.22:
+            msgs = [self._rand_payload() for _ in range(rng.randint(1, 3))]
+            frame = b"".join(m.encode() for m in msgs)
+        elif roll < 0.34:
+            frame = self._rand_batch().encode()
+        elif roll < 0.42:
+            frame = self._poison_batch().encode()
+        elif roll < 0.58:
+            frame = self._rand_attestation().encode()
+        elif roll < 0.68:
+            frame = self._rand_batch_attestation().encode()
+        elif roll < 0.75:
+            frame = self._oversized_batch_attestation().encode()
+        elif roll < 0.84:
+            frame = self._rand_catchup_junk().encode()
+        elif roll < 0.93 and self.sent_log:
+            frame = rng.choice(self.sent_log)  # verbatim replay
+        else:
+            frame = self._malformed()
+        self.sent_log.append(frame)
+        return frame

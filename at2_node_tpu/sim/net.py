@@ -1,0 +1,389 @@
+"""SimNet: an n-node f-tolerant network of REAL ``Service`` cores on a
+virtual clock and simulated fabric, plus the AT2 invariant checker.
+
+The services here are not mocks: the full bring-up path runs (broadcast
+planes, delivery→commit loop, catchup runner, admission), with exactly
+three substitutions via ``Service.start``'s simulator seams — the
+virtual clock, a ``SimMesh`` in place of the socket mesh, and
+``serve_rpc=False`` (client traffic enters through the real
+``SendAsset`` handler called with a simulated gRPC context, so
+validation and admission still run).
+
+Keys, catchup nonces, and all fabric randomness derive from the net's
+seed; under ``SimScheduler`` the entire run is a pure function of
+``(seed, config, events)``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, List, Optional
+
+from ..crypto.keys import ExchangeKeyPair, SignKeyPair
+from ..crypto.verifier import CpuVerifier
+from ..net.peers import Peer
+from ..node.config import Config
+from ..node.service import Service
+from ..proto import at2_pb2 as pb
+from ..types import ThinTransaction
+from .fabric import LinkModel, SimFabric, SimMesh
+from .scheduler import SimClock, SimScheduler
+
+
+class InvariantViolation(AssertionError):
+    """An AT2 safety property failed; carries all violation strings."""
+
+    def __init__(self, violations: List[str]):
+        super().__init__("; ".join(violations))
+        self.violations = violations
+
+
+class SimRpcError(Exception):
+    """What ``context.abort`` raises in the sim (mirrors grpc's
+    AbortError: the handler never resumes past an abort)."""
+
+    def __init__(self, code, details: str = ""):
+        super().__init__(f"{code}: {details}")
+        self.code = code
+        self.details = details
+
+
+class _SimContext:
+    """The slice of the grpc.aio servicer context the handlers use."""
+
+    def __init__(self, source: str):
+        self._source = source
+
+    def peer(self) -> str:
+        return self._source
+
+    async def abort(self, code, details: str = "") -> None:
+        raise SimRpcError(code, details)
+
+
+def sim_keypairs(seed: int, i: int):
+    """Deterministic node identity i for a given net seed."""
+    import hashlib
+
+    sk = hashlib.sha256(f"at2-sim-sign-{seed}-{i}".encode()).digest()
+    xk = hashlib.sha256(f"at2-sim-xchg-{seed}-{i}".encode()).digest()
+    return SignKeyPair(sk), ExchangeKeyPair(xk)
+
+
+def sim_client(seed: int, i: int) -> SignKeyPair:
+    """Deterministic client identity i (disjoint from node identities)."""
+    import hashlib
+
+    return SignKeyPair(
+        hashlib.sha256(f"at2-sim-client-{seed}-{i}".encode()).digest()
+    )
+
+
+class SimNet:
+    """``n`` correct nodes (+ ``hostile`` configured-but-unstarted
+    byzantine identities) on one fabric. Construct, ``start()``, drive
+    with ``submit``/``run_for``/``settle``, then ``check_invariants``
+    and ``close``."""
+
+    def __init__(
+        self,
+        n: int = 4,
+        f: int = 1,
+        seed: int = 0,
+        *,
+        hostile: int = 0,
+        link: Optional[LinkModel] = None,
+        echo_threshold: Optional[int] = None,
+        ready_threshold: Optional[int] = None,
+        **config_overrides,
+    ) -> None:
+        self.n = n
+        self.f = f
+        self.seed = seed
+        self.loop = SimScheduler()
+        asyncio.set_event_loop(self.loop)
+        self.clock = SimClock(self.loop)
+        self.fabric = SimFabric(self.loop, seed=seed, default_link=link)
+        total = n + hostile
+        n_peers = total - 1  # thresholds count peers, self excluded
+        if echo_threshold is None:
+            # With live byzantine identities the echo/ready quorum must
+            # satisfy 2q - n_peers > h (two quorums intersect in a
+            # correct node); with only crash/link faults, n_peers - f
+            # keeps liveness through f unreachable peers while two
+            # quorums still intersect in >= 1 (correct) node.
+            if hostile:
+                echo_threshold = (n_peers + hostile) // 2 + 1
+            else:
+                echo_threshold = max(1, n_peers - f)
+        if ready_threshold is None:
+            ready_threshold = echo_threshold
+        self.echo_threshold = echo_threshold
+        self.ready_threshold = ready_threshold
+
+        keys = [sim_keypairs(seed, i) for i in range(total)]
+        peers = [
+            Peer(f"sim-{i}:0", keys[i][1].public, keys[i][0].public)
+            for i in range(total)
+        ]
+        self.peers = peers
+        self.configs: List[Config] = []
+        for i in range(total):
+            cfg = Config(
+                node_address=f"sim-{i}:0",
+                rpc_address=f"sim-rpc-{i}:0",
+                sign_key=keys[i][0],
+                network_key=keys[i][1],
+                echo_threshold=echo_threshold,
+                ready_threshold=ready_threshold,
+                **config_overrides,
+            )
+            cfg.nodes = [p for j, p in enumerate(peers) if j != i]
+            self.configs.append(cfg)
+
+        self.services: List[Service] = []
+        self.hostile_configs = self.configs[n:]
+        self.touched: set = set()  # account keys episodes interacted with
+        self._started = False
+        self.verifier = CpuVerifier()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SimNet":
+        for i in range(self.n):
+            cfg = self.configs[i]
+            mesh_factory = lambda c, on_frame: SimMesh(  # noqa: E731
+                self.fabric, c.sign_key.public, c.nodes, on_frame
+            )
+            service = self.loop.run_until_complete(
+                Service.start(
+                    cfg,
+                    verifier=self.verifier,
+                    clock=self.clock,
+                    mesh_factory=mesh_factory,
+                    serve_rpc=False,
+                )
+            )
+            # catchup session nonces from the net seed, not secrets
+            service._nonce_bits = random.Random(
+                (self.seed << 8) | i
+            ).getrandbits
+            self.services.append(service)
+        self._started = True
+        return self
+
+    def close(self) -> None:
+        for s in self.services:
+            try:
+                self.loop.run_until_complete(s.close())
+            except Exception:
+                pass
+        self.services.clear()
+        try:
+            self.loop.run_until_complete(self.verifier.close())
+        except Exception:
+            pass
+        self.loop.close()
+        asyncio.set_event_loop(None)
+
+    def __enter__(self) -> "SimNet":
+        return self.start() if not self._started else self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- driving -----------------------------------------------------------
+
+    def run_for(self, duration: float) -> None:
+        self.loop.run_for(duration)
+
+    async def asubmit(
+        self,
+        node: int,
+        client: SignKeyPair,
+        sequence: int,
+        recipient: bytes,
+        amount: int,
+        *,
+        good_sig: bool = True,
+        source: Optional[str] = None,
+    ) -> Optional[SimRpcError]:
+        """One client transaction through the real SendAsset handler
+        (validation + admission + ingress batcher). Returns the
+        handler's outcome: ``None`` on accept, the ``SimRpcError`` on
+        rejection (rejections are normal traffic in hostile episodes)."""
+        tx = ThinTransaction(recipient, amount)
+        sig = (
+            client.sign(tx.signing_bytes())
+            if good_sig
+            else b"\x5a" * 64
+        )
+        request = pb.SendAssetRequest(
+            sender=client.public,
+            sequence=sequence,
+            recipient=recipient,
+            amount=amount,
+            signature=sig,
+        )
+        ctx = _SimContext(source or f"sim-client-{client.public[:4].hex()}")
+        self.touched.add(client.public)
+        self.touched.add(recipient)
+        try:
+            await self.services[node].SendAsset(request, ctx)
+            return None
+        except SimRpcError as exc:
+            return exc
+
+    def submit(self, node: int, client: SignKeyPair, sequence: int,
+               recipient: bytes, amount: int, **kw):
+        """Synchronous wrapper over :meth:`asubmit` for direct driving."""
+        return self.loop.run_until_complete(
+            self.asubmit(node, client, sequence, recipient, amount, **kw)
+        )
+
+    def settle(
+        self, horizon: float = 120.0, window: float = 5.0, stable: int = 4
+    ) -> float:
+        """Advance virtual time until the net is quiescent — ledger
+        progress (commits, retained history) stable for ``stable``
+        consecutive windows — or the horizon is reached. Wire chatter is
+        deliberately NOT part of the signal: catchup polling and
+        retransmission of permanently-poisoned slots keep the fabric
+        busy forever; what matters is that they stopped changing
+        committed state. The default window (stable * window = 20s
+        virtual) exceeds the retransmission and catchup periods, so a
+        heal in flight always gets a chance to land before we stop.
+        Returns virtual seconds consumed."""
+        last = None
+        streak = 0
+        t = 0.0
+        while t < horizon:
+            self.loop.run_for(window)
+            t += window
+            snap = (
+                tuple(s.committed for s in self.services),
+                tuple(len(s.history) for s in self.services),
+            )
+            if snap == last:
+                streak += 1
+                if streak >= stable:
+                    return t
+            else:
+                streak = 0
+            last = snap
+        return t
+
+    # -- invariants --------------------------------------------------------
+
+    def check_invariants(self) -> List[str]:
+        """The AT2 safety properties, checked across all correct nodes.
+        Returns violation strings (empty = all green)."""
+        return self.loop.run_until_complete(self._check())
+
+    def assert_invariants(self) -> None:
+        violations = self.check_invariants()
+        if violations:
+            raise InvariantViolation(violations)
+
+    async def _check(self) -> List[str]:
+        violations: List[str] = []
+        services = self.services
+
+        # every account any node knows about, plus everything submitted
+        keys: set = set(self.touched)
+        for s in services:
+            keys.update(s.accounts.frontier_nowait().keys())
+
+        # 1. agreement: identical balance and frontier everywhere
+        for key in sorted(keys):
+            seqs = {await s.accounts.get_last_sequence(key) for s in services}
+            if len(seqs) != 1:
+                violations.append(
+                    f"frontier divergence for {key.hex()[:16]}: {sorted(seqs)}"
+                )
+            bals = {await s.accounts.get_balance(key) for s in services}
+            if len(bals) != 1:
+                violations.append(
+                    f"balance divergence for {key.hex()[:16]}: {sorted(bals)}"
+                )
+
+        # 2. sieve consistency + no double-spend past the sequence gate:
+        # a (sender, seq) slot commits at most ONE content network-wide,
+        # and each node's history for a sender is gap-free up to its
+        # frontier (the gate admits seq k only after k-1).
+        slot_content: Dict[tuple, bytes] = {}
+        for si, s in enumerate(services):
+            frontier = s.accounts.frontier_nowait()
+            for sender, last_seq in frontier.items():
+                payloads = s.history.get_range(sender, 1, last_seq + 1)
+                got = {p.sequence for p in payloads}
+                # history is capacity-bounded; only flag gaps the ring
+                # still covers
+                expected = set(range(1, last_seq + 1))
+                missing = expected - got
+                if missing and len(s.history) < s.config.catchup.history_cap:
+                    violations.append(
+                        f"node {si}: history gap for {sender.hex()[:16]}: "
+                        f"missing seqs {sorted(missing)[:8]}"
+                    )
+                for p in payloads:
+                    slot = (sender, p.sequence)
+                    chash = p.content_hash()
+                    seen = slot_content.get(slot)
+                    if seen is None:
+                        slot_content[slot] = chash
+                    elif seen != chash:
+                        violations.append(
+                            "sieve violation: slot "
+                            f"({sender.hex()[:16]}, {p.sequence}) committed "
+                            "two contents"
+                        )
+
+        # 3. totality: a slot committed anywhere is committed everywhere
+        # (after quiescence + catchup, all correct nodes hold the union)
+        for sender, seq in sorted(slot_content):
+            for si, s in enumerate(services):
+                if s.accounts.frontier_nowait().get(sender, 0) < seq:
+                    violations.append(
+                        f"totality violation: node {si} missing slot "
+                        f"({sender.hex()[:16]}, {seq})"
+                    )
+
+        # 4. conservation: replaying each node's committed history from
+        # fresh-account state reproduces its reported balances exactly
+        for si, s in enumerate(services):
+            expect: Dict[bytes, int] = {}
+            frontier = s.accounts.frontier_nowait()
+            ok_replay = True
+            for sender, last_seq in sorted(frontier.items()):
+                payloads = s.history.get_range(sender, 1, last_seq + 1)
+                if len(payloads) < last_seq:
+                    ok_replay = False  # ring evicted history: cannot replay
+                    continue
+                for p in payloads:
+                    expect[p.sender] = (
+                        expect.get(p.sender, 100_000) - p.transaction.amount
+                    )
+                    expect[p.transaction.recipient] = (
+                        expect.get(p.transaction.recipient, 100_000)
+                        + p.transaction.amount
+                    )
+            if ok_replay:
+                for key, want in sorted(expect.items()):
+                    got = await s.accounts.get_balance(key)
+                    if got != want:
+                        violations.append(
+                            f"conservation violation on node {si}: "
+                            f"{key.hex()[:16]} balance {got} != replayed {want}"
+                        )
+        return violations
+
+
+__all__ = [
+    "InvariantViolation",
+    "SimNet",
+    "SimRpcError",
+    "sim_client",
+    "sim_keypairs",
+]

@@ -10,10 +10,12 @@ Usage:
     python -m at2_node_tpu.tools.top HOST:PORT [HOST:PORT ...]
         [--interval 2.0] [--once] [--no-clear] [--json]
 
-``--once`` renders a single frame and exits (CI smoke / scripting);
-``--json`` dumps the raw per-node /statusz snapshots instead of the
-table. A node that fails to answer renders as DOWN and keeps the loop
-alive — mid-restart nodes are exactly when you want the dashboard up.
+``--once`` renders a single frame and exits — nonzero when any polled
+node is down or reports degraded health, so scripts and CI can gate on
+fleet health; ``--json`` dumps the raw per-node /statusz snapshots
+instead of the table. In watch mode a node that fails to answer renders
+as DOWN and keeps the loop alive — mid-restart nodes are exactly when
+you want the dashboard up.
 """
 
 from __future__ import annotations
@@ -140,7 +142,17 @@ async def run(addrs, interval: float, once: bool, clear: bool,
             if not isinstance(sz, Exception):
                 prev[addr] = (now, _num(sz.get("health", {}), "committed"))
         if once:
-            return 0 if any(not isinstance(r, Exception) for _, r in rows) else 1
+            # scripting/CI contract: nonzero when ANY polled node is
+            # unreachable or self-reports degraded health — a fleet
+            # where one node answers is not a healthy fleet
+            bad = [
+                addr for addr, sz in rows
+                if isinstance(sz, Exception)
+                or sz.get("health", {}).get("status") != "ok"
+            ]
+            if bad:
+                print(f"unhealthy: {', '.join(bad)}", file=sys.stderr)
+            return 1 if bad else 0
         await asyncio.sleep(interval)
 
 
@@ -150,7 +162,8 @@ def main(argv=None) -> int:
                     help="rpc addresses of the nodes to watch")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
-                    help="render one frame and exit (nonzero if ALL down)")
+                    help="render one frame and exit (nonzero if any node "
+                         "is down or reports degraded health)")
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen")
     ap.add_argument("--json", action="store_true",

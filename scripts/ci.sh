@@ -58,6 +58,32 @@ echo "== poison-slot chaos gate =="
 python -m pytest tests/test_faults.py::TestPoisonChaos \
     tests/test_poison_resolution.py -q
 
+echo "== sim determinism gate =="
+# Deterministic simulation (ISSUE 4): the same seed must reproduce the
+# same campaign hash (sha256 over per-episode wire-trace hashes),
+# byte-identical, run to run. PYTHONHASHSEED is pinned because set
+# iteration order feeds the schedule.
+export PYTHONHASHSEED=0
+sim_hash() {
+  python -m at2_node_tpu.tools.sim_run --seed 7 --episodes 3 --quiet \
+    | sed -n 's/.*hash \([0-9a-f]*\).*/\1/p'
+}
+h1="$(sim_hash)"
+h2="$(sim_hash)"
+if [ -z "$h1" ] || [ "$h1" != "$h2" ]; then
+  echo "sim determinism gate FAILED: '$h1' != '$h2'" >&2
+  exit 1
+fi
+echo "same-seed campaign hash reproduced: $h1"
+
+echo "== sim invariant campaign (50 episodes) =="
+# Seeded adversarial campaign on the simulated fabric: 50 episodes of
+# the real 4-node f=1 stack under loss, partitions, equivocation, and
+# hostile frames — every AT2 invariant (agreement, sieve consistency,
+# totality, conservation) checked per episode. Exit nonzero on any
+# violation; the printed episode seed is the exact replay recipe.
+python -m at2_node_tpu.tools.sim_run --seed 1 --episodes 50 --quiet
+
 if [ "$tier" = "all" ]; then
   echo "== native sanitizers (TSAN + ASAN) =="
   # the reference gets race-freedom from Rust; the C++ prep library gets

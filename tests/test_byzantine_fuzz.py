@@ -4,11 +4,11 @@ The scripted byzantine test (tests/test_byzantine.py) drives ONE
 deterministic interleaving; the broadcast fuzz tier
 (tests/test_broadcast_fuzz.py) randomizes schedules but runs ABOVE the
 transport. This campaign closes the gap between them: a seeded generator
-drives random HOSTILE FRAME SEQUENCES over the real encrypted transport
-against a live 4-node net — valid-but-conflicting attestations, batch
-equivocation, random bitmaps, malformed bodies, replays, catchup-plane
-junk, interleaved across nodes and schedules — and asserts the safety
-invariants after every episode:
+(`at2_node_tpu.sim.hostile.HostileFrameGen`) drives random HOSTILE
+FRAME SEQUENCES against a live 4-node net — valid-but-conflicting
+attestations, batch equivocation, random bitmaps, malformed bodies,
+replays, catchup-plane junk, interleaved across nodes and schedules —
+and asserts the safety invariants after every episode:
 
 * liveness: fresh honest traffic still commits on every correct node;
 * agreement: all correct nodes report identical frontiers and balances
@@ -16,6 +16,15 @@ invariants after every episode:
 * no fabricated content ever reaches the ledger (balances of hostile
   recipients match across nodes — either the one winning content or
   nothing).
+
+The 24-episode campaign runs on the DETERMINISTIC SIM FABRIC
+(at2_node_tpu/sim): same real node logic, same frame generators,
+virtual time instead of wall-clock waits — plus the full AT2 invariant
+sweep (totality, sieve consistency, conservation) at campaign end. One
+single-episode campaign stays on the real encrypted transport as the
+TRANSPORT-INTEGRATION CANARY (frame framing, AEAD, channel lifecycle
+facing hostile bytes), with a native-reader variant when the C++ plane
+is available.
 
 Seed discipline: the campaign seed defaults to a fixed value (CI
 determinism) and can be overridden with AT2_FUZZ_SEED; every failure
@@ -30,53 +39,34 @@ import asyncio
 import itertools
 import os
 import random
-import struct
 
 import pytest
 
-from at2_node_tpu.broadcast.messages import (
-    BATCH_ECHO,
-    BATCH_READY,
-    ECHO,
-    READY,
-    Attestation,
-    BatchAttestation,
-    BatchContentRequest,
-    ContentRequest,
-    HistoryBatch,
-    HistoryIndexRequest,
-    HistoryRequest,
-    Payload,
-    TxBatch,
-)
 from at2_node_tpu.client import Client
 from at2_node_tpu.crypto.keys import SignKeyPair
 from at2_node_tpu.net import transport
 from at2_node_tpu.node.service import Service
-from at2_node_tpu.types import ThinTransaction
+from at2_node_tpu.sim.hostile import HostileFrameGen
+from at2_node_tpu.sim.net import SimNet, sim_client
 
 from conftest import make_net_configs, wait_until
 
 _ports = itertools.count(25400)
 
 FAUCET = 100_000
-N_EPISODES = 24
+N_EPISODES = 24  # sim-fabric campaign
 FRAMES_PER_EPISODE = 40
+CANARY_EPISODES = 1  # live-socket transport canary
 
 
-class _HostileFuzzer:
-    """Authenticated byzantine peer emitting seeded random frame salvos."""
+class _HostileFuzzer(HostileFrameGen):
+    """The shared frame generator plus real encrypted transport
+    channels — the live-socket canary's byzantine peer."""
 
     def __init__(self, config, rng: random.Random):
-        self.sign = config.sign_key
+        super().__init__(config.sign_key, rng)
         self.network = config.network_key
-        self.rng = rng
         self.channels = {}
-        self.sent_log = []  # replay source
-        # identities this fuzzer signs client payloads with
-        self.clients = [SignKeyPair.random() for _ in range(3)]
-        self.recipients = [SignKeyPair.random().public for _ in range(3)]
-        self.batches = []  # real TxBatches sent: targets for oversized bitmaps
 
     async def dial(self, cfgs):
         for i, cfg in enumerate(cfgs):
@@ -88,183 +78,6 @@ class _HostileFuzzer:
     def close(self):
         for ch in self.channels.values():
             ch.close()
-
-    # -- frame builders ---------------------------------------------------
-
-    def _payload(self, client, seq, recipient, amount, good_sig=True):
-        tx = ThinTransaction(recipient, amount)
-        sig = (
-            client.sign(tx.signing_bytes())
-            if good_sig
-            else bytes(self.rng.getrandbits(8) for _ in range(64))
-        )
-        return Payload(client.public, seq, tx, sig)
-
-    def _rand_payload(self):
-        rng = self.rng
-        return self._payload(
-            rng.choice(self.clients),
-            rng.randint(1, 4),
-            rng.choice(self.recipients),
-            rng.randint(1, 50),
-            good_sig=rng.random() > 0.25,
-        )
-
-    def _rand_batch(self):
-        rng = self.rng
-        entries = b"".join(
-            self._rand_payload().encode()[1:]
-            for _ in range(rng.randint(1, 6))
-        )
-        batch = TxBatch.create(self.sign, rng.randint(1, 5), entries)
-        self.batches.append(batch)
-        return batch
-
-    def _poison_batch(self):
-        """A batch GUARANTEED to carry at least one never-verifiable
-        entry among honest-looking ones — the poison-slot resolution
-        path's bread and butter (slot must retire, never stall)."""
-        rng = self.rng
-        payloads = [self._rand_payload() for _ in range(rng.randint(1, 4))]
-        payloads.insert(
-            rng.randrange(len(payloads) + 1),
-            self._payload(
-                rng.choice(self.clients),
-                rng.randint(1, 4),
-                rng.choice(self.recipients),
-                rng.randint(1, 50),
-                good_sig=False,
-            ),
-        )
-        entries = b"".join(p.encode()[1:] for p in payloads)
-        batch = TxBatch.create(self.sign, rng.randint(1, 5), entries)
-        self.batches.append(batch)
-        return batch
-
-    def _oversized_batch_attestation(self):
-        """A correctly signed attestation for a REAL previously-sent
-        batch whose bitmap claims far more entries than the batch has:
-        exercises the width clamp (phantom bits must not grow nbits or
-        spuriously quorate). Falls back to a random one before any batch
-        exists."""
-        rng = self.rng
-        if not self.batches:
-            return self._rand_batch_attestation()
-        batch = rng.choice(self.batches)
-        phase = rng.choice((BATCH_ECHO, BATCH_READY))
-        bitmap = bytes(
-            rng.getrandbits(8) | 1 for _ in range(rng.choice((16, 64, 128)))
-        )
-        sig = self.sign.sign(
-            BatchAttestation.signing_bytes(
-                phase, batch.origin, batch.batch_seq, batch.content_hash(), bitmap
-            )
-        )
-        return BatchAttestation(
-            phase,
-            self.sign.public,
-            batch.origin,
-            batch.batch_seq,
-            batch.content_hash(),
-            bitmap,
-            sig,
-        )
-
-    def _rand_attestation(self):
-        rng = self.rng
-        phase = rng.choice((ECHO, READY))
-        sender = rng.choice(self.clients).public
-        seq = rng.randint(1, 4)
-        chash = (
-            self._rand_payload().content_hash()
-            if rng.random() < 0.6
-            else bytes(rng.getrandbits(8) for _ in range(32))
-        )
-        sig = self.sign.sign(
-            Attestation.signing_bytes(phase, sender, seq, chash)
-        )
-        return Attestation(phase, self.sign.public, sender, seq, chash, sig)
-
-    def _rand_batch_attestation(self):
-        rng = self.rng
-        phase = rng.choice((BATCH_ECHO, BATCH_READY))
-        b_origin = self.sign.public
-        b_seq = rng.randint(1, 5)
-        b_hash = bytes(rng.getrandbits(8) for _ in range(32))
-        bitmap = bytes(
-            rng.getrandbits(8) for _ in range(rng.choice((1, 2, 16, 128)))
-        )
-        sig = self.sign.sign(
-            BatchAttestation.signing_bytes(phase, b_origin, b_seq, b_hash, bitmap)
-        )
-        return BatchAttestation(
-            phase, self.sign.public, b_origin, b_seq, b_hash, bitmap, sig
-        )
-
-    def _rand_catchup_junk(self):
-        rng = self.rng
-        kind = rng.randrange(4)
-        if kind == 0:
-            return HistoryIndexRequest(rng.getrandbits(64))
-        if kind == 1:
-            return HistoryRequest(
-                rng.getrandbits(64),
-                rng.choice(self.clients).public,
-                1,
-                rng.randint(1, 1 << 20),  # absurd range: server must clamp
-            )
-        if kind == 2:
-            return HistoryBatch(
-                rng.getrandbits(64),
-                tuple(self._rand_payload() for _ in range(rng.randint(1, 4))),
-            )
-        return ContentRequest(
-            rng.choice(self.clients).public,
-            rng.randint(1, 4),
-            bytes(rng.getrandbits(8) for _ in range(32)),
-        )
-
-    def _malformed(self) -> bytes:
-        rng = self.rng
-        choice = rng.randrange(4)
-        if choice == 0:  # unknown kind
-            return bytes([rng.randint(13, 255)]) + bytes(
-                rng.getrandbits(8) for _ in range(rng.randint(0, 64))
-            )
-        if choice == 1:  # truncated known message
-            full = self._rand_payload().encode()
-            return full[: rng.randint(1, len(full) - 1)]
-        if choice == 2:  # batch header with an absurd count field
-            b = bytearray(self._rand_batch().encode())
-            b[41:45] = struct.pack("<I", rng.randint(1025, 1 << 30))
-            return bytes(b)
-        # random garbage
-        return bytes(rng.getrandbits(8) for _ in range(rng.randint(1, 200)))
-
-    def next_frame(self) -> bytes:
-        rng = self.rng
-        roll = rng.random()
-        if roll < 0.22:
-            msgs = [self._rand_payload() for _ in range(rng.randint(1, 3))]
-            frame = b"".join(m.encode() for m in msgs)
-        elif roll < 0.34:
-            frame = self._rand_batch().encode()
-        elif roll < 0.42:
-            frame = self._poison_batch().encode()
-        elif roll < 0.58:
-            frame = self._rand_attestation().encode()
-        elif roll < 0.68:
-            frame = self._rand_batch_attestation().encode()
-        elif roll < 0.75:
-            frame = self._oversized_batch_attestation().encode()
-        elif roll < 0.84:
-            frame = self._rand_catchup_junk().encode()
-        elif roll < 0.93 and self.sent_log:
-            frame = rng.choice(self.sent_log)  # verbatim replay
-        else:
-            frame = self._malformed()
-        self.sent_log.append(frame)
-        return frame
 
     async def episode(self, n_frames: int) -> None:
         rng = self.rng
@@ -292,13 +105,97 @@ async def _agreement(services, identities):
 
 
 class TestByzantineWireFuzz:
-    @pytest.mark.asyncio
-    async def test_seeded_campaign(self):
-        await self._campaign()
+    def test_seeded_campaign_sim_fabric(self):
+        """The full 24-episode campaign on the deterministic simulated
+        fabric: virtual time, seeded delivery jitter, exact replay from
+        (AT2_FUZZ_SEED). SYNC test: it owns the virtual event loop."""
+        campaign_seed = int(os.environ.get("AT2_FUZZ_SEED", "20260731"))
+        rng = random.Random(campaign_seed)
+        net = SimNet(
+            n=4,
+            f=1,
+            seed=campaign_seed,
+            hostile=1,
+            echo_threshold=3,
+            ready_threshold=3,
+        ).start()
+        honest = sim_client(campaign_seed, 100)
+        honest_rcpt = sim_client(campaign_seed, 101).public
+        try:
+            hostile = HostileFrameGen(net.hostile_configs[0].sign_key, rng)
+            node_signs = [c.sign_key.public for c in net.configs[:4]]
+
+            def frontier(key):
+                return [
+                    net.loop.run_until_complete(
+                        s.accounts.get_last_sequence(key)
+                    )
+                    for s in net.services
+                ]
+
+            for ep in range(N_EPISODES):
+                ep_seed = rng.getrandbits(32)
+                hostile.rng.seed(ep_seed)
+                try:
+                    for _ in range(FRAMES_PER_EPISODE):
+                        frame = hostile.next_frame()
+                        targets = hostile.rng.sample(
+                            range(4), hostile.rng.randint(1, 4)
+                        )
+                        for t in targets:
+                            net.fabric.inject(
+                                hostile.sign.public, node_signs[t], frame
+                            )
+                        net.run_for(0.02)
+                    # liveness: honest traffic commits everywhere
+                    seq = ep + 1
+                    err = net.submit(0, honest, seq, honest_rcpt, 1)
+                    assert err is None, f"honest tx rejected: {err}"
+                    for _ in range(240):
+                        net.run_for(0.5)
+                        if all(fr >= seq for fr in frontier(honest.public)):
+                            break
+                    else:
+                        raise AssertionError(
+                            "honest tx did not commit on all nodes: "
+                            f"{frontier(honest.public)}"
+                        )
+                    # agreement on everything the episode touched
+                    touched = (
+                        [c.public for c in hostile.clients]
+                        + list(hostile.recipients)
+                        + [honest.public, honest_rcpt]
+                    )
+                    net.loop.run_until_complete(
+                        _agreement(net.services, touched)
+                    )
+                except AssertionError as exc:
+                    raise AssertionError(
+                        f"episode {ep} (seed {ep_seed}, campaign "
+                        f"{campaign_seed}): {exc}"
+                    ) from exc
+            # hostile frames never killed a correct node's inbound plane
+            for s in net.services:
+                assert s.broadcast.stats["delivered"] >= N_EPISODES
+            # beyond the live test: settle and sweep the FULL invariant
+            # set (agreement + sieve consistency + totality +
+            # conservation) across everything the campaign committed
+            net.settle(horizon=90.0)
+            violations = net.check_invariants()
+            assert violations == [], violations
+        finally:
+            net.close()
 
     @pytest.mark.asyncio
-    async def test_seeded_campaign_native_reader_plane(self, monkeypatch):
-        """Same campaign with the C++ channel readers forced on: the
+    async def test_live_socket_canary(self):
+        """One episode over the REAL encrypted transport: the
+        integration the sim fabric abstracts away (framing, AEAD,
+        channel lifecycle) still faces hostile bytes every CI run."""
+        await self._live_campaign()
+
+    @pytest.mark.asyncio
+    async def test_live_socket_canary_native_reader_plane(self, monkeypatch):
+        """Same canary with the C++ channel readers forced on: the
         native inbound plane (socket reads, AEAD, frame assembly, wake
         batching, chained delivery) faces the hostile frame generator
         too."""
@@ -307,9 +204,9 @@ class TestByzantineWireFuzz:
         if _lib_with_reader() is None:
             pytest.skip("native reader library unavailable")
         monkeypatch.setenv("AT2_FORCE_NATIVE_READER", "1")
-        await self._campaign(seed_offset=1)
+        await self._live_campaign(seed_offset=1)
 
-    async def _campaign(self, seed_offset: int = 0):
+    async def _live_campaign(self, seed_offset: int = 0):
         campaign_seed = (
             int(os.environ.get("AT2_FUZZ_SEED", "20260731")) + seed_offset
         )
@@ -323,7 +220,7 @@ class TestByzantineWireFuzz:
         try:
             await hostile.dial(cfgs[:4])
             async with Client(f"http://{cfgs[0].rpc_address}") as client:
-                for ep in range(N_EPISODES):
+                for ep in range(CANARY_EPISODES):
                     ep_seed = rng.getrandbits(32)
                     hostile.rng.seed(ep_seed)
                     try:
@@ -366,7 +263,7 @@ class TestByzantineWireFuzz:
             # crashed (all four answered every round)
             for s in services:
                 st = s.broadcast.stats
-                assert st["delivered"] >= N_EPISODES  # honest slots
+                assert st["delivered"] >= CANARY_EPISODES  # honest slots
         finally:
             hostile.close()
             for s in services:

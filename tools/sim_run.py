@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""Repo-root shim for the deterministic simulation CLI.
+
+Same interface as ``python -m at2_node_tpu.tools.sim_run`` (the
+canonical home); this wrapper only makes `tools/sim_run.py --seed S
+--episodes 50` work from a checkout without installing the package.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from at2_node_tpu.tools.sim_run import _pin_hashseed, main  # noqa: E402
+
+if __name__ == "__main__":
+    _pin_hashseed()
+    sys.exit(main())
