@@ -397,6 +397,11 @@ class Broadcast:
     committed payloads from :attr:`delivered` (an asyncio.Queue of
     :class:`Payload`, drained in batches by the service's delivery loop)."""
 
+    # class-level default so partially-constructed instances (tests build
+    # bare objects via __new__ to unit-test single methods) read "no
+    # recorder" instead of raising AttributeError
+    recorder = None
+
     def __init__(
         self,
         keypair: SignKeyPair,
@@ -407,6 +412,7 @@ class Broadcast:
         workers: int = 16,
         registry=None,
         trace=None,
+        recorder=None,
         clock=None,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
@@ -483,6 +489,10 @@ class Broadcast:
 
         self.registry = Registry() if registry is None else registry
         self.trace = trace
+        # protocol flight recorder (obs/recorder.py); None = not recording.
+        # Sites guard with ``is not None`` so the disabled path costs one
+        # attribute read.
+        self.recorder = recorder
         self.registry.gauge(
             "slots_undelivered", "live undelivered broadcast slots",
             fn=lambda: self._undelivered,
@@ -541,13 +551,19 @@ class Broadcast:
         one GIL-released pass). Drops (best-effort plane) when the inbox
         is saturated — by entry count OR byte budget — rather than
         back-pressuring the socket."""
+        if self.recorder is not None and frame:
+            self.recorder.record("rx", (frame[0], len(frame), peer.address))
         if self._inbox_bytes + len(frame) > INBOX_MAX_BYTES:
             logger.warning("inbox byte budget exhausted; dropping frame")
+            if self.recorder is not None:
+                self.recorder.record("rx_drop", ("bytes", len(frame)))
             return
         try:
             self._inbox.put_nowait((peer, frame))
         except asyncio.QueueFull:
             logger.warning("inbox overflow; dropping frame")
+            if self.recorder is not None:
+                self.recorder.record("rx_drop", ("depth", len(frame)))
         else:
             self._inbox_bytes += len(frame)
 
@@ -650,12 +666,16 @@ class Broadcast:
                     self._stall_backoff = min(
                         self._stall_backoff * 2, STALL_KICK_MAX_INTERVAL
                     )
+                    if self.recorder is not None:
+                        self.recorder.record("stall_kick", ())
                     try:
                         self.stall_handler()
                     except Exception:
                         logger.exception("stall handler error")
                 else:
                     self.stats["stall_kicks_suppressed"] += 1
+                    if self.recorder is not None:
+                        self.recorder.record("stall_kick_suppressed", ())
             elif not stalled_past_horizon:
                 # healthy pass: re-arm the hysteresis for the next storm
                 self._stall_backoff = STALL_KICK_MIN_INTERVAL
@@ -951,6 +971,8 @@ class Broadcast:
         # at most the worker pool's chunk capacity — negligible vs the cap.
         if slot not in self._slots and self._undelivered >= MAX_LIVE_SLOTS:
             self.stats["slots_dropped"] += 1
+            if self.recorder is not None:
+                self.recorder.record("slot_drop", ("gossip", slot[1]))
             return False
         chash = payload.content_hash()
         key = (slot, chash)
@@ -1001,6 +1023,8 @@ class Broadcast:
         # capacity drops must not poison the dedup set or burn verifier time.
         if slot not in self._slots and self._undelivered >= MAX_LIVE_SLOTS:
             self.stats["slots_dropped"] += 1
+            if self.recorder is not None:
+                self.recorder.record("slot_drop", ("attestation", slot[1]))
             return False
         # Exact-duplicate suppression keyed INCLUDING the signature, so a
         # forged message can never shadow the origin's real (differently
@@ -1068,6 +1092,8 @@ class Broadcast:
                 state.echoed_hash = chash
                 if self.trace is not None:
                     self.trace.stamp(slot, "echoed")
+                if self.recorder is not None:
+                    self.recorder.record("echo", (payload.sequence,))
                 self._send_attestation(
                     ECHO, payload.sender, payload.sequence, chash
                 )
@@ -1300,6 +1326,11 @@ class Broadcast:
                     self.trace.stamp(ekey, "echoed")
             state.own_echo_bits[chash] = bits
             state.rejected_bits[chash] = rejected
+            if self.recorder is not None:
+                self.recorder.record(
+                    "batch_echo",
+                    (slot[1], bits.bit_count(), rejected.bit_count()),
+                )
             if bits:
                 self._send_batch_attestation(
                     BATCH_ECHO, slot, chash, bits, batch.count
@@ -1369,6 +1400,10 @@ class Broadcast:
         att = BatchAttestation(
             phase, self.keypair.public, slot[0], slot[1], chash, bitmap, sig
         )
+        if self.recorder is not None:
+            self.recorder.record(
+                "tx", (phase, slot[1], 1 if peer is not None else 0)
+            )
         if peer is not None:
             self.mesh.send(peer, att.encode())
         else:
@@ -1437,6 +1472,12 @@ class Broadcast:
         state.delivered_bits[chash] = (
             state.delivered_bits.get(chash, 0) | deliverable
         )
+        if self.recorder is not None:
+            # quorum edge: these entries just crossed their Ready quorum
+            # (on the batched plane that IS the delivery condition)
+            self.recorder.record(
+                "batch_deliver", (slot[1], deliverable.bit_count())
+            )
         entries = batch.entries()
         d = deliverable
         while d:
@@ -1526,6 +1567,10 @@ class Broadcast:
         self.stats["slots_retired"] += 1
         poison = rejected & ~delivered
         self.stats["poison_resolved"] += poison.bit_count()
+        if self.recorder is not None:
+            self.recorder.record(
+                "slot_retire", (slot[1], poison.bit_count())
+            )
 
     def _poison_blocked_only(self, state: _BatchState) -> bool:
         """True when every undelivered entry is one this node rejected at
@@ -1603,6 +1648,10 @@ class Broadcast:
         when ``peer`` is given (straggler help)."""
         sig = self.keypair.sign(Attestation.signing_bytes(phase, sender, sequence, chash))
         att = Attestation(phase, self.keypair.public, sender, sequence, chash, sig)
+        if self.recorder is not None:
+            self.recorder.record(
+                "tx", (phase, sequence, 1 if peer is not None else 0)
+            )
         if peer is not None:
             self.mesh.send(peer, att.encode())
         else:
@@ -1620,6 +1669,8 @@ class Broadcast:
             and len(state.echoes[chash]) >= self.echo_threshold
         ):
             state.sieve_delivered = True
+            if self.recorder is not None:
+                self.recorder.record("echo_quorum", (slot[1],))
             if not state.ready_sent:
                 state.ready_sent = True
                 state.ready_hash = chash
@@ -1644,6 +1695,8 @@ class Broadcast:
                 self.stats["delivered"] += 1
                 if self.trace is not None:
                     self.trace.stamp(slot, "delivered")
+                if self.recorder is not None:
+                    self.recorder.record("ready_quorum", (slot[1],))
                 self.delivered.put_nowait(state.contents[chash])
             else:
                 # quorum reached but the gossip never landed here: pull the

@@ -260,6 +260,12 @@ class TpuBatchVerifier:
         self.h_dispatch = Histogram(
             "dispatch", "prep -> results pipeline latency per batch"
         )
+        # optional protocol flight recorder (obs/recorder.py), attached
+        # by the owning Service after start: flush decisions (take /
+        # depth / bucket) are exactly the events a post-mortem needs to
+        # explain a latency spike. Duck-typed so the verifier keeps its
+        # no-registry, no-obs-import design.
+        self.recorder = None
 
     def stats(self) -> dict:
         """Operator-facing counters: batch occupancy, padding ratio, and
@@ -466,6 +472,11 @@ class TpuBatchVerifier:
             if not self._queue:
                 continue
             take = self._take_for_flush()
+            if self.recorder is not None:
+                self.recorder.record(
+                    "vflush",
+                    (take, len(self._queue), self._bucket_for(take)),
+                )
             batch, self._queue = (
                 self._queue[:take],
                 self._queue[take:],

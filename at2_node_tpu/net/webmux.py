@@ -432,11 +432,13 @@ class PortMux:
                         buf.extend(chunk)
                     del buf[:get_len]
             handler = getattr(self.servicer, "obs_http", None)
-            route = path.split("?", 1)[0]
+            route = path.split("?", 1)[0]  # query-free form, for logging
             result = None
             if callable(handler):
                 try:
-                    result = handler(route)
+                    # full path INCLUDING the query string: the handler
+                    # parses parameters itself (e.g. /tracez?limit=N)
+                    result = handler(path)
                 except Exception:
                     logger.exception("obs handler failed for %s", route)
                     await self._respond(

@@ -81,19 +81,28 @@ class ObservabilityConfig:
     read-only views and share the mux's connection caps).
     ``trace_sample`` = trace every Nth ingress transaction through the
     lifecycle tracker (1 = all, 0 = off); ``trace_cap`` bounds live
-    (uncommitted) traces — see obs/trace.py for the eviction policy."""
+    (uncommitted) traces — see obs/trace.py for the eviction policy.
+    ``trace_done_cap`` bounds the completed-trace ring served on
+    /tracez; ``recorder_cap`` sizes the protocol flight-recorder ring
+    served on /debugz (obs/recorder.py; 0 disables recording)."""
 
     stats_interval: float = 0.0  # seconds between stats lines; 0 = off
     profile_dir: str = ""  # jax.profiler trace output dir; "" = off
     endpoints: bool = True  # GET /metrics /healthz /statusz on the mux
     trace_sample: int = 1  # trace every Nth ingress tx; 0 disables
     trace_cap: int = 8192  # max live (uncommitted) traces
+    trace_done_cap: int = 1024  # completed traces retained for /tracez
+    recorder_cap: int = 2048  # flight-recorder ring size; 0 disables
 
     def __post_init__(self) -> None:
         if self.trace_sample < 0:
             raise ValueError("observability.trace_sample must be >= 0")
         if self.trace_cap < 1:
             raise ValueError("observability.trace_cap must be >= 1")
+        if self.trace_done_cap < 1:
+            raise ValueError("observability.trace_done_cap must be >= 1")
+        if self.recorder_cap < 0:
+            raise ValueError("observability.recorder_cap must be >= 0")
 
 
 @dataclass
@@ -234,6 +243,8 @@ class Config:
                 f"endpoints = {'true' if obs.endpoints else 'false'}",
                 f"trace_sample = {obs.trace_sample}",
                 f"trace_cap = {obs.trace_cap}",
+                f"trace_done_cap = {obs.trace_done_cap}",
+                f"recorder_cap = {obs.recorder_cap}",
             ]
         if self.checkpoint.path:
             lines += [

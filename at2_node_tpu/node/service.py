@@ -50,8 +50,9 @@ from ..ledger.accounts import AccountModificationError, Accounts
 from ..ledger.recent import RecentTransactions
 from ..net.peers import Mesh, Peer
 from ..net.webmux import PortMux
+from ..obs.recorder import FlightRecorder
 from ..obs.registry import Registry
-from ..obs.trace import TxTrace
+from ..obs.trace import REJECTED, TxTrace
 from ..proto import at2_pb2 as pb
 from ..proto.rpc import At2Servicer, add_to_server
 from ..types import ThinTransaction, TransactionState, rfc3339
@@ -156,7 +157,24 @@ class Service(At2Servicer):
             self.registry,
             sample_every=obs.trace_sample,
             cap=obs.trace_cap,
+            done_cap=obs.trace_done_cap,
+            clock=self.clock,
         )
+        # protocol flight recorder (obs/recorder.py): always on (bounded
+        # ring), dumped via /debugz, auto-snapshotted on anomalies
+        # (healthz flipping to degraded, a stall kick)
+        self.recorder = FlightRecorder(
+            cap=obs.recorder_cap, clock=self.clock
+        )
+        self.registry.gauge(
+            "recorder_events", "protocol events ever flight-recorded",
+            fn=lambda: self.recorder.recorded,
+        )
+        self.registry.gauge(
+            "recorder_snapshots", "anomaly snapshots captured",
+            fn=lambda: self.recorder.snapshots_taken,
+        )
+        self._health_was_ok = True
         self._started_at = self.clock.monotonic()
         self.verifier: Optional[Verifier] = None
         self.mesh: Optional[Mesh] = None
@@ -243,6 +261,12 @@ class Service(At2Servicer):
             fn=lambda: len(self.history),
         )
         self.registry.register_provider("verifier_", self._verifier_stats)
+        # verifier per-stage latency as REAL histograms (bucket/sum/count
+        # on /metrics — the plain provider above only carries its stats()
+        # spot values), so external scrapers can aggregate across nodes
+        self.registry.register_histogram_provider(
+            "verifier_stage_", self._verifier_stage_hists
+        )
         self.registry.register_provider(
             "mesh_",
             lambda: self.mesh.stats() if self.mesh is not None else {},
@@ -325,8 +349,18 @@ class Service(At2Servicer):
                 ready_threshold=config.ready_threshold,
                 registry=service.registry,
                 trace=service.tx_trace,
+                recorder=(
+                    service.recorder if service.recorder.enabled else None
+                ),
                 clock=service.clock,
             )
+            # flight-record the verifier's flush decisions too (duck-typed
+            # attach; a SHARED verifier keeps its first owner's recorder)
+            if (
+                service.recorder.enabled
+                and getattr(service.verifier, "recorder", ()) is None
+            ):
+                service.verifier.recorder = service.recorder
             service.broadcast.catchup_handler = service._on_catchup
             if config.catchup.enabled:
                 # broadcast GC signal: a slot stalled past push-
@@ -506,6 +540,19 @@ class Service(At2Servicer):
         fn = getattr(self.verifier, "stats", None)
         return fn() if callable(fn) else {}
 
+    def _verifier_stage_hists(self) -> dict:
+        """Expose the TPU verifier's stage Histograms to the registry's
+        histogram-provider path (full _bucket/_sum/_count exposition).
+        CpuVerifier has no stage histograms — empty dict, no families."""
+        if self.verifier is None:
+            return {}
+        out = {}
+        for name in ("queue_wait", "prep", "launch", "finish", "dispatch"):
+            h = getattr(self.verifier, f"h_{name}", None)
+            if h is not None:
+                out[name] = h
+        return out
+
     def snapshot_stats(self) -> dict:
         """One structured stats record: broadcast per-stage counters +
         verifier batch metrics + commit progress (SURVEY.md §5). Now a
@@ -534,23 +581,64 @@ class Service(At2Servicer):
 
     def obs_http(self, path: str):
         """Route one GET. Returns (status, content_type, body) or None
-        for 404 (unknown path, or endpoints disabled in config)."""
+        for 404 (unknown path, or endpoints disabled in config). ``path``
+        may carry a query string (the mux passes it through verbatim);
+        only /tracez reads one (``?limit=N`` bounds the completed-trace
+        payload)."""
         if not self.config.observability.endpoints:
             return None
-        if path == "/metrics":
+        route, _, query = path.partition("?")
+        if route == "/metrics":
             body = self.registry.render_prometheus().encode()
             return 200, self._OBS_PROM, body
-        if path == "/healthz":
+        if route == "/healthz":
             verdict = self.health_verdict()
             status = 200 if verdict["status"] == "ok" else 503
             body = json.dumps(verdict, sort_keys=True).encode()
             return status, self._OBS_JSON, body
-        if path == "/statusz":
+        if route == "/statusz":
             body = json.dumps(
                 self.statusz(), sort_keys=True, default=float
             ).encode()
             return 200, self._OBS_JSON, body
+        if route == "/tracez":
+            limit = None
+            for part in query.split("&"):
+                if part.startswith("limit="):
+                    try:
+                        limit = max(0, int(part[6:]))
+                    except ValueError:
+                        pass
+            body = json.dumps(
+                self.tracez(limit), sort_keys=True, default=float
+            ).encode()
+            return 200, self._OBS_JSON, body
+        if route == "/debugz":
+            body = json.dumps(
+                self.debugz(), sort_keys=True, default=float
+            ).encode()
+            return 200, self._OBS_JSON, body
         return None
+
+    def tracez(self, limit: int | None = None) -> dict:
+        """Live + completed lifecycle traces plus a paired clock reading
+        (tools/trace_collect.py joins records by (sender, seq) across
+        nodes and normalizes on the wall stamps)."""
+        return {
+            "node": self.config.sign_key.public.hex()[:16],
+            "clock": {
+                "monotonic": round(self.clock.monotonic(), 9),
+                "wall": round(self.clock.wall(), 9),
+            },
+            **self.tx_trace.tracez(limit),
+        }
+
+    def debugz(self) -> dict:
+        """The flight recorder's ring + anomaly snapshots."""
+        return {
+            "node": self.config.sign_key.public.hex()[:16],
+            "recorder": self.recorder.dump(),
+        }
 
     def health_verdict(self) -> dict:
         """Liveness + quorum/stall verdict. ``status`` is "ok" only when
@@ -575,6 +663,17 @@ class Service(At2Servicer):
         stall_horizon = max(self.config.catchup.after * 2, 5.0)
         stalled = oldest is not None and now - oldest > stall_horizon
         ok = quorum_ok and not stalled and not self._closing
+        # anomaly-triggered capture: the moment health flips ok->degraded
+        # (for a real reason, not shutdown), freeze the flight recorder so
+        # the lead-up survives ring rollover. Edge-triggered on the
+        # transition, so a poll loop hammering a degraded node takes ONE
+        # snapshot per incident, not one per scrape.
+        if not ok and self._health_was_ok and not self._closing:
+            self.recorder.snapshot(
+                "healthz_degraded:"
+                + ("stalled" if stalled else "quorum_lost")
+            )
+        self._health_was_ok = ok
         return {
             "status": "ok" if ok else "degraded",
             "closing": self._closing,
@@ -944,6 +1043,11 @@ class Service(At2Servicer):
 
     def _kick_catchup(self) -> None:
         if self._catchup_task is None or self._catchup_task.done():
+            # a stall kick IS an anomaly: freeze the flight recorder so
+            # the 2s before the stall are inspectable after the fact.
+            # Single-flight gated (like the runner itself), so a stall
+            # persisting across GC passes takes one snapshot per session.
+            self.recorder.snapshot("stall_kick")
             # the initial delay gives a transient gap (predecessor still
             # in flight through the broadcast) time to resolve without a
             # session, and paces back-to-back kicks
@@ -1212,6 +1316,13 @@ class Service(At2Servicer):
         bucket = self._admission_refill(source, self.clock.monotonic())
         if bucket[0] < 1.0:
             self.admission_stats["admission_throttled"] += 1
+            # terminal trace stamp + flight-record BEFORE the abort
+            # raises: a throttled tx's trace must retire into the
+            # completed ring, not linger until cap eviction
+            self._trace_stamp(payloads, REJECTED)
+            self.recorder.record(
+                "admit_throttle", (len(payloads), source)
+            )
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 "too many invalid signatures from this source; retry later",
@@ -1227,6 +1338,12 @@ class Service(At2Servicer):
             return
         self.admission_stats["rejected_at_ingress"] += len(bad)
         bucket[0] = max(0.0, bucket[0] - len(bad))
+        # admission is all-or-nothing: the whole request aborts, so EVERY
+        # entry's trace terminates here (the bad ones failed verification,
+        # the good ones were refused alongside them and may retry under a
+        # fresh ingress)
+        self._trace_stamp(payloads, REJECTED)
+        self.recorder.record("admit_reject", (len(bad), source))
         await context.abort(
             grpc.StatusCode.INVALID_ARGUMENT,
             "client signature verification failed"

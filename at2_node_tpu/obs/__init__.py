@@ -1,7 +1,9 @@
-"""Observability subsystem: metrics registry, lifecycle tracing, wire
-exposition. See registry.py / trace.py module docstrings and the
-TECHNICAL.md "Observability" section for the contracts."""
+"""Observability subsystem: metrics registry, lifecycle tracing, the
+protocol flight recorder, wire exposition. See registry.py / trace.py /
+recorder.py module docstrings and the TECHNICAL.md "Observability" and
+"Fleet tracing & flight recorder" sections for the contracts."""
 
+from .recorder import FlightRecorder
 from .registry import (
     Counter,
     CounterGroup,
@@ -9,13 +11,15 @@ from .registry import (
     Histogram,
     Registry,
 )
-from .trace import STAGES, TxTrace
+from .trace import REJECTED, STAGES, TxTrace
 
 __all__ = [
     "Counter",
     "CounterGroup",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "REJECTED",
     "Registry",
     "STAGES",
     "TxTrace",

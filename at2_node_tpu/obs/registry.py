@@ -289,6 +289,7 @@ class Registry:
         self._lock = threading.Lock()
         self._instruments: dict[str, Counter | Gauge | Histogram] = {}
         self._providers: list[tuple[str, Callable[[], dict]]] = []
+        self._hist_providers: list[tuple[str, Callable[[], dict]]] = []
 
     # -- instrument construction (get-or-create, kind-checked) ----------
 
@@ -333,6 +334,20 @@ class Registry:
         with self._lock:
             self._providers.append((prefix, fn))
 
+    def register_histogram_provider(
+        self, prefix: str, fn: Callable[[], dict]
+    ) -> None:
+        """Expose EXTERNALLY-owned ``Histogram`` objects (``fn`` returns
+        ``{suffix: Histogram}``) with the full Prometheus histogram
+        convention — cumulative ``_bucket{le=...}`` series, ``_sum``,
+        ``_count`` — instead of the spot-percentile gauges a plain stats
+        provider would yield. The verifier's per-stage histograms are
+        the motivating case: they are constructed by the verifier (which
+        deliberately has no registry), yet external scrapers need real
+        buckets to aggregate latency across nodes."""
+        with self._lock:
+            self._hist_providers.append((prefix, fn))
+
     # -- views -----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -342,6 +357,7 @@ class Registry:
         with self._lock:
             instruments = list(self._instruments.values())
             providers = list(self._providers)
+            hist_providers = list(self._hist_providers)
         out: dict = {}
         for inst in instruments:
             if isinstance(inst, Histogram):
@@ -355,6 +371,13 @@ class Registry:
                 continue  # a dead provider must not take /statusz down
             if extra:
                 out.update({f"{prefix}{k}": v for k, v in extra.items()})
+        for prefix, fn in hist_providers:
+            try:
+                hists = fn()
+            except Exception:
+                continue
+            for suffix, h in sorted(hists.items()):
+                out.update(h.flat(f"{prefix}{suffix}"))
         return out
 
     def render_prometheus(self, namespace: str = "at2") -> str:
@@ -365,7 +388,21 @@ class Registry:
         with self._lock:
             instruments = list(self._instruments.values())
             providers = list(self._providers)
+            hist_providers = list(self._hist_providers)
         lines: list[str] = []
+
+        def emit_histogram(base: str, h: Histogram, help_text: str) -> None:
+            fam = f"{base}_seconds"
+            if help_text:
+                lines.append(f"# HELP {fam} {help_text}")
+            lines.append(f"# TYPE {fam} histogram")
+            buckets, total, count = h.buckets()
+            for bound, cum in buckets:
+                le = "+Inf" if math.isinf(bound) else _fmt(bound)
+                lines.append(f'{fam}_bucket{{le="{le}"}} {cum}')
+            lines.append(f"{fam}_sum {_fmt(total)}")
+            lines.append(f"{fam}_count {count}")
+
         for inst in instruments:
             base = f"{namespace}_{_sanitize(inst.name)}"
             if isinstance(inst, Counter):
@@ -380,16 +417,16 @@ class Registry:
                 lines.append(f"# TYPE {base} gauge")
                 lines.append(f"{base} {_fmt(inst.value)}")
             else:
-                fam = f"{base}_seconds"
-                if inst.help:
-                    lines.append(f"# HELP {fam} {inst.help}")
-                lines.append(f"# TYPE {fam} histogram")
-                buckets, total, count = inst.buckets()
-                for bound, cum in buckets:
-                    le = "+Inf" if math.isinf(bound) else _fmt(bound)
-                    lines.append(f'{fam}_bucket{{le="{le}"}} {cum}')
-                lines.append(f"{fam}_sum {_fmt(total)}")
-                lines.append(f"{fam}_count {count}")
+                emit_histogram(base, inst, inst.help)
+        for prefix, fn in hist_providers:
+            try:
+                hists = fn()
+            except Exception:
+                continue
+            for suffix, h in sorted(hists.items()):
+                emit_histogram(
+                    f"{namespace}_{_sanitize(prefix + suffix)}", h, h.help
+                )
         for prefix, fn in providers:
             try:
                 extra = fn()

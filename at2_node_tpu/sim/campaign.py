@@ -150,6 +150,11 @@ class EpisodeResult:
     virtual_time: float
     wall_seconds: float
     minimized: Optional[List[Event]] = None
+    # failing episodes carry the fleet's observability state next to the
+    # reproducer: per-node flight-recorder dumps + the cross-node
+    # stitched timeline of every traced tx (tools/trace_collect.stitch).
+    # Deterministic under sim virtual time — same seed, same artifact.
+    obs: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -169,7 +174,25 @@ class EpisodeResult:
             "wall_seconds": round(self.wall_seconds, 3),
             "events": self.events,
             "minimized": self.minimized,
+            "obs": self.obs,
         }
+
+
+def _capture_obs(net: SimNet) -> dict:
+    """Freeze the fleet's observability state while the net is still
+    open: one flight-recorder dump per node plus the stitched cross-node
+    timeline. Reads net.services directly — sim nodes don't serve the
+    HTTP mux, and the capture must happen before net.close() tears the
+    services down."""
+    from ..tools.trace_collect import stitch  # tools -> sim is the
+    # import direction elsewhere; keep this one lazy to avoid a cycle
+
+    for svc in net.services:
+        svc.recorder.snapshot("episode_capture")
+    return {
+        "recorders": [svc.debugz() for svc in net.services],
+        "stitched": stitch([svc.tracez() for svc in net.services]),
+    }
 
 
 def _install_interposer(net: SimNet, rules: List[list]) -> None:
@@ -305,10 +328,16 @@ def run_episode(
     echo_threshold: Optional[int] = None,
     ready_threshold: Optional[int] = None,
     config_overrides: Optional[dict] = None,
+    capture_obs: Optional[bool] = None,
 ) -> EpisodeResult:
     """One self-contained episode: fresh SimNet, (generated or given)
     events, run + settle, invariant check, teardown. Pure in
-    ``(seed, parameters, events)``."""
+    ``(seed, parameters, events)``.
+
+    ``capture_obs``: None (default) attaches recorder dumps + the
+    stitched timeline exactly when the episode fails invariants; True
+    always captures; False never does (minimization re-runs use this —
+    they only need the boolean verdict)."""
     wall0 = time.monotonic()
     rng = random.Random(_seed_int("episode", seed))
     net = SimNet(
@@ -346,6 +375,9 @@ def run_episode(
         net.fabric.heal_all()
         virtual = last_t + 1.0 + net.settle(horizon=settle_horizon)
         violations = net.check_invariants()
+        obs = None
+        if capture_obs or (capture_obs is None and violations):
+            obs = _capture_obs(net)
         return EpisodeResult(
             seed=seed,
             events=events,
@@ -356,9 +388,87 @@ def run_episode(
             dropped=net.fabric.dropped,
             virtual_time=virtual,
             wall_seconds=time.monotonic() - wall0,
+            obs=obs,
         )
     finally:
         net.close()
+
+
+def planted_breach_episode(
+    seed: int = 20260805, *, capture_obs: Optional[bool] = None
+) -> EpisodeResult:
+    """The canonical planted safety bug, as a one-call reproducer: echo
+    and ready thresholds forced to 1 (below the quorum-intersection
+    bound), honest attestations suppressed net-wide, and a hostile peer
+    hand-delivering a split vote for an equivocating client — nodes 0
+    and 1 commit divergent contents and the invariant checker flags a
+    sieve violation.
+
+    scripts/ci.sh runs this to assert the failure artifact carries
+    per-node flight-recorder dumps and the stitched cross-node timeline
+    of the offending tx; tests/test_sim.py asserts the same shape."""
+    from ..broadcast.messages import ECHO, READY, Attestation, Payload
+    from ..node.config import BatchingConfig
+    from ..types import ThinTransaction
+    from .net import sim_keypairs
+
+    clients = [sim_client(seed, i) for i in range(4)]
+    hostile_sign, _ = sim_keypairs(seed, 4)  # identity 4: hostile peer
+
+    def payload(to_i, amount):
+        tx = ThinTransaction(clients[to_i].public, amount)
+        return Payload(
+            clients[0].public, 1, tx, clients[0].sign(tx.signing_bytes())
+        )
+
+    def att_frames(chash):
+        out = []
+        for phase in (ECHO, READY):
+            sig = hostile_sign.sign(
+                Attestation.signing_bytes(phase, clients[0].public, 1, chash)
+            )
+            out.append(
+                Attestation(
+                    phase, hostile_sign.public, clients[0].public, 1,
+                    chash, sig,
+                ).encode().hex()
+            )
+        return out
+
+    echo_a, ready_a = att_frames(payload(1, 5).content_hash())
+    echo_b, ready_b = att_frames(payload(2, 6).content_hash())
+    events = [
+        [0.0, "drop", {"src": s, "kinds": [2, 3], "duration": 60.0}]
+        for s in range(4)
+    ] + [
+        [
+            0.2,
+            "equiv",
+            {
+                "node_a": 0,
+                "node_b": 1,
+                "client": 0,
+                "seq": 1,
+                "to_a": 1,
+                "to_b": 2,
+                "amount_a": 5,
+                "amount_b": 6,
+            },
+        ],
+        [0.6, "inject", {"src_hostile": 1, "target": 0, "frame": echo_a}],
+        [0.6, "inject", {"src_hostile": 1, "target": 0, "frame": ready_a}],
+        [0.6, "inject", {"src_hostile": 1, "target": 1, "frame": echo_b}],
+        [0.6, "inject", {"src_hostile": 1, "target": 1, "frame": ready_b}],
+    ]
+    return run_episode(
+        seed,
+        events=events,
+        echo_threshold=1,
+        ready_threshold=1,
+        config_overrides={"batching": BatchingConfig(enabled=False)},
+        settle_horizon=40.0,
+        capture_obs=capture_obs,
+    )
 
 
 def minimize_events(
@@ -439,6 +549,7 @@ def run_campaign(
                         hostile=hostile,
                         events=evs,
                         link=link,
+                        capture_obs=False,
                     ).violations
                 ),
             )

@@ -16,9 +16,14 @@ entries (the batched broadcast plane, broadcast/stack.py) — the lever
 VERDICT r4 asked to measure at {1, 16, 64}; ``--batch 0`` (default)
 drives the per-tx plane.
 
+``--obs off`` disables the lifecycle tracer and the protocol flight
+recorder (trace_sample=0, recorder_cap=0) so the observability overhead
+can be measured as the delta between two otherwise-identical runs — the
+ISSUE 6 acceptance budget is <5% throughput regression with both on.
+
 Usage:
     python -m at2_node_tpu.tools.plane_bench [--nodes 3] [--txs 300]
-        [--verifier cpu] [--batch 0] [--out -]
+        [--verifier cpu] [--batch 0] [--obs on|off] [--out -]
 """
 
 from __future__ import annotations
@@ -31,7 +36,7 @@ import time
 
 from ..broadcast.messages import Payload, TxBatch
 from ..crypto.keys import SignKeyPair
-from ..node.config import VerifierConfig
+from ..node.config import ObservabilityConfig, VerifierConfig
 from ..node.service import Service
 from ..types import ThinTransaction
 from ._common import make_net_configs, port_counter
@@ -68,13 +73,19 @@ class _TrustAllVerifier:
 
 
 async def run(
-    nodes: int, txs: int, verifier: str, timeout: float, batch: int = 0
+    nodes: int, txs: int, verifier: str, timeout: float, batch: int = 0,
+    obs: bool = True,
 ) -> dict:
     plane_only = verifier == "plane-only"
     cfgs = make_net_configs(
         nodes,
         _ports,
         verifier=VerifierConfig(kind="cpu" if plane_only else verifier),
+        observability=(
+            ObservabilityConfig()
+            if obs
+            else ObservabilityConfig(trace_sample=0, recorder_cap=0)
+        ),
     )
     injected = _TrustAllVerifier() if plane_only else None
     services = []
@@ -130,6 +141,7 @@ async def run(
             "nodes": nodes,
             "verifier": verifier,
             "batch": batch,
+            "obs": obs,
             "submitted": txs,
             "committed_per_node": committed,
             "seconds": round(dt, 3),
@@ -178,10 +190,14 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--batch", type=int, default=0)
+    ap.add_argument("--obs", default="on", choices=("on", "off"),
+                    help="lifecycle tracer + flight recorder (off: measure "
+                         "the plane with zero observability overhead)")
     ap.add_argument("--out", default="-")
     args = ap.parse_args(argv)
     result = asyncio.run(
-        run(args.nodes, args.txs, args.verifier, args.timeout, args.batch)
+        run(args.nodes, args.txs, args.verifier, args.timeout, args.batch,
+            obs=args.obs == "on")
     )
     blob = json.dumps(result, indent=1)
     if args.out == "-":

@@ -9,6 +9,7 @@ and no dependencies beyond the stdlib.
 Usage:
     python -m at2_node_tpu.tools.top HOST:PORT [HOST:PORT ...]
         [--interval 2.0] [--once] [--no-clear] [--json]
+        [--tracez] [--limit N]
 
 ``--once`` renders a single frame and exits — nonzero when any polled
 node is down or reports degraded health, so scripts and CI can gate on
@@ -16,6 +17,12 @@ fleet health; ``--json`` dumps the raw per-node /statusz snapshots
 instead of the table. In watch mode a node that fails to answer renders
 as DOWN and keeps the loop alive — mid-restart nodes are exactly when
 you want the dashboard up.
+
+``--tracez`` switches the whole tool into a tail: it polls each node's
+/tracez and prints every NEWLY completed lifecycle trace (one line per
+tx: terminal, total latency, per-stage offsets) — `tail -f` for the
+protocol. Use tools/trace_collect.py when you want the cross-node
+stitched view instead of the per-node stream.
 """
 
 from __future__ import annotations
@@ -29,14 +36,16 @@ import time
 _GET_TIMEOUT = 5.0
 
 
-async def fetch_statusz(host: str, port: int, timeout: float = _GET_TIMEOUT):
-    """One raw HTTP/1 GET /statusz (no http client dependency)."""
+async def fetch_json(host: str, port: int, path: str,
+                     timeout: float = _GET_TIMEOUT):
+    """One raw HTTP/1 GET of a JSON obs endpoint (no http client
+    dependency) — shared by top, trace_collect, and the benches."""
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(host, port), timeout
     )
     try:
         writer.write(
-            f"GET /statusz HTTP/1.1\r\nHost: {host}\r\n"
+            f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
             "Connection: close\r\n\r\n".encode()
         )
         await writer.drain()
@@ -52,6 +61,11 @@ async def fetch_statusz(host: str, port: int, timeout: float = _GET_TIMEOUT):
     if " 200 " not in f"{status_line} ":
         raise RuntimeError(f"{host}:{port} answered {status_line!r}")
     return json.loads(body)
+
+
+async def fetch_statusz(host: str, port: int, timeout: float = _GET_TIMEOUT):
+    """One raw HTTP/1 GET /statusz."""
+    return await fetch_json(host, port, "/statusz", timeout)
 
 
 def _parse_addr(spec: str):
@@ -72,7 +86,8 @@ def render_frame(rows, now: float, prev) -> str:
     tx/s delta. Pure function of its inputs — unit-testable."""
     cols = (
         f"{'node':<22}{'health':<9}{'tx/s':>8}{'committed':>11}"
-        f"{'p50 ms':>9}{'p99 ms':>9}{'vrf occ':>9}{'q-wait p99':>12}"
+        f"{'p50 ms':>9}{'p99 ms':>9}{'dlv p99':>9}{'live tr':>9}"
+        f"{'rej':>6}{'vrf occ':>9}{'q-wait p99':>12}"
         f"{'backlog':>9}{'peers':>7}"
     )
     lines = [cols, "-" * len(cols)]
@@ -82,7 +97,10 @@ def render_frame(rows, now: float, prev) -> str:
             continue
         stats = sz.get("stats", {})
         health = sz.get("health", {})
-        life = sz.get("tx_lifecycle", {}).get("ingress_to_committed", {})
+        lifecycle = sz.get("tx_lifecycle", {})
+        life = lifecycle.get("ingress_to_committed", {})
+        dlv = lifecycle.get("ingress_to_delivered", {})
+        rej = lifecycle.get("ingress_to_rejected", {})
         vstages = sz.get("verifier_stages", {})
         committed = _num(health, "committed")
         rate = ""
@@ -100,6 +118,9 @@ def render_frame(rows, now: float, prev) -> str:
             f"{committed:>11}"
             f"{_num(life, 'p50_ms'):>9.1f}"
             f"{_num(life, 'p99_ms'):>9.1f}"
+            f"{_num(dlv, 'p99_ms'):>9.1f}"
+            f"{_num(lifecycle, 'live_traces'):>9}"
+            f"{_num(rej, 'count'):>6}"
             f"{occ_s:>9}"
             f"{qw_s:>12}"
             f"{_num(stats, 'slots_undelivered'):>9}"
@@ -107,6 +128,56 @@ def render_frame(rows, now: float, prev) -> str:
             f"{_num(health, 'peers_configured'):<2}"
         )
     return "\n".join(lines)
+
+
+def render_trace_lines(addr: str, dump: dict, seen: set) -> list:
+    """Format NEWLY completed traces from one node's /tracez dump as
+    tail lines; ``seen`` tracks (sender, seq) already printed for that
+    node. Pure function of its inputs — unit-testable."""
+    lines = []
+    for rec in dump.get("completed", ()):
+        key = (rec["sender"], rec["seq"])
+        if key in seen:
+            continue
+        seen.add(key)
+        stages = rec.get("stages", ())
+        t0 = stages[0][2] if stages else 0.0
+        total_ms = 1e3 * (stages[-1][2] - t0) if len(stages) > 1 else 0.0
+        hops = " ".join(
+            f"{s}+{1e3 * (w - t0):.2f}" for s, _m, w in stages[1:]
+        )
+        lines.append(
+            f"{addr:<22}{rec['sender'][:12]}#{rec['seq']:<6}"
+            f"{rec.get('terminal') or '?':<10}{total_ms:>9.2f}ms  {hops}"
+        )
+    return lines
+
+
+async def run_tracez(addrs, interval: float, once: bool, limit,
+                     out=None) -> int:
+    """Tail mode: stream completed lifecycle traces as they retire."""
+    out = out or sys.stdout
+    seen: dict = {}
+    path = "/tracez" + (f"?limit={limit}" if limit is not None else "")
+    while True:
+        results = await asyncio.gather(
+            *(fetch_json(h, p, path, min(_GET_TIMEOUT, max(interval, 0.5)))
+              for h, p in addrs),
+            return_exceptions=True,
+        )
+        for (h, p), r in zip(addrs, results):
+            addr = f"{h}:{p}"
+            if isinstance(r, Exception):
+                print(f"{addr:<22}DOWN {type(r).__name__}: {r}",
+                      file=out, flush=True)
+                continue
+            for line in render_trace_lines(
+                addr, r, seen.setdefault(addr, set())
+            ):
+                print(line, file=out, flush=True)
+        if once:
+            return 0
+        await asyncio.sleep(interval)
 
 
 async def _poll(addrs, timeout: float):
@@ -168,9 +239,18 @@ def main(argv=None) -> int:
                     help="append frames instead of clearing the screen")
     ap.add_argument("--json", action="store_true",
                     help="dump raw /statusz snapshots instead of the table")
+    ap.add_argument("--tracez", action="store_true",
+                    help="tail completed lifecycle traces from /tracez "
+                         "instead of rendering the dashboard")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="with --tracez: newest N completed traces per poll")
     args = ap.parse_args(argv)
     addrs = [_parse_addr(a) for a in args.nodes]
     try:
+        if args.tracez:
+            return asyncio.run(
+                run_tracez(addrs, args.interval, args.once, args.limit)
+            )
         return asyncio.run(
             run(addrs, args.interval, args.once,
                 clear=not args.no_clear, as_json=args.json)

@@ -76,6 +76,35 @@ if [ -z "$h1" ] || [ "$h1" != "$h2" ]; then
 fi
 echo "same-seed campaign hash reproduced: $h1"
 
+echo "== flight-recorder capture gate =="
+# Fleet tracing + flight recorder (ISSUE 6): a planted safety breach
+# (thresholds below the quorum-intersection bound + a split vote) must
+# fail invariants AND the failure artifact must carry its black box —
+# one flight-recorder dump per node with events, plus the stitched
+# cross-node timeline of the offending tx with straggler attribution.
+python - <<'EOF'
+from at2_node_tpu.sim.campaign import planted_breach_episode
+
+r = planted_breach_episode(20260805)
+assert r.violations, "planted breach must violate invariants"
+obs = r.obs
+assert obs is not None, "failing episode must attach obs artifact"
+recs = obs["recorders"]
+assert len(recs) == 4, f"want 4 recorder dumps, got {len(recs)}"
+for dump in recs:
+    assert dump["recorder"]["events"], (
+        f"node {dump['node']}: empty flight-recorder ring"
+    )
+offending = [tx for tx in obs["stitched"]["txs"] if tx["seq"] == 1]
+assert offending, "stitched timeline must contain the offending tx"
+assert offending[0]["nodes"] >= 2, "timeline must span multiple nodes"
+assert offending[0]["stragglers"], "straggler attribution missing"
+print(
+    "breach artifact ok: 4 recorder dumps, offending tx stitched across"
+    f" {offending[0]['nodes']} nodes"
+)
+EOF
+
 echo "== sim invariant campaign (50 episodes) =="
 # Seeded adversarial campaign on the simulated fabric: 50 episodes of
 # the real 4-node f=1 stack under loss, partitions, equivocation, and

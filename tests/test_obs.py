@@ -30,9 +30,11 @@ from at2_node_tpu.net.peers import Peer
 from at2_node_tpu.node.config import Config, ObservabilityConfig
 from at2_node_tpu.node.service import Service
 from at2_node_tpu.obs import (
+    REJECTED,
     STAGES,
     Counter,
     CounterGroup,
+    FlightRecorder,
     Gauge,
     Histogram,
     Registry,
@@ -301,6 +303,243 @@ class TestTxTrace:
             TxTrace(r, sample_every=-1)
         with pytest.raises(ValueError):
             TxTrace(r, cap=0)
+        with pytest.raises(ValueError):
+            TxTrace(r, done_cap=0)
+
+    def test_stamps_carry_mono_and_wall_timestamps(self):
+        # every stage retains BOTH clocks: monotonic for local deltas,
+        # wall for the cross-node join (tools/trace_collect.py)
+        r = Registry()
+        tr = TxTrace(r, sample_every=1)
+        key = (b"s" * 32, 1)
+        tr.begin(key)
+        tr.stamp(key, "admitted")
+        rec = tr.tracez()["live"][0]
+        assert rec["sender"] == (b"s" * 32).hex() and rec["seq"] == 1
+        assert rec["origin"] is True and rec["terminal"] is None
+        assert [s[0] for s in rec["stages"]] == ["ingress", "admitted"]
+        for _stage, mono, wall in rec["stages"]:
+            assert isinstance(mono, float) and isinstance(wall, float)
+
+    def test_committed_retires_into_completed_ring(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=1)
+        key = (b"s" * 32, 1)
+        tr.begin(key, now=0.0)
+        for i, stage in enumerate(STAGES[1:], start=1):
+            tr.stamp(key, stage, now=float(i))
+        z = tr.tracez()
+        assert z["live"] == []
+        (rec,) = z["completed"]
+        assert rec["terminal"] == "committed"
+        assert [s[0] for s in rec["stages"]] == list(STAGES)
+
+    def test_rejected_is_terminal_and_feeds_histogram(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=1)
+        key = (b"s" * 32, 1)
+        tr.begin(key, now=10.0)
+        tr.stamp(key, REJECTED, now=10.5)
+        assert tr.live == 0
+        (rec,) = tr.tracez()["completed"]
+        assert rec["terminal"] == REJECTED
+        snap = tr.snapshot()
+        assert snap["ingress_to_rejected"]["count"] == 1
+        assert snap["ingress_to_rejected"]["max_ms"] == pytest.approx(
+            500.0, abs=1.0
+        )
+        assert r.counter("tx_trace_rejected").value == 1
+        # rejection never resurrects: later stamps on the key are no-ops
+        tr.stamp(key, "committed", now=11.0)
+        assert r.counter("tx_trace_completed").value == 0
+
+    def test_completed_ring_bounded_by_done_cap(self):
+        r = Registry()
+        tr = TxTrace(r, sample_every=1, done_cap=3)
+        for seq in range(1, 6):
+            key = (b"s" * 32, seq)
+            tr.begin(key)
+            tr.stamp(key, "committed")
+        done = tr.tracez()["completed"]
+        assert [rec["seq"] for rec in done] == [3, 4, 5]
+        # limit keeps the NEWEST n; 0 keeps none
+        assert [r_["seq"] for r_ in tr.tracez(limit=2)["completed"]] == [4, 5]
+        assert tr.tracez(limit=0)["completed"] == []
+
+    def test_relay_records_join_without_feeding_histograms(self):
+        # a stamp for a key never seen at ingress opens a RELAY span:
+        # counted separately, kept out of the latency histograms (no
+        # ingress t0 to measure from), exported for the stitcher
+        r = Registry()
+        tr = TxTrace(r, sample_every=1)
+        key = (b"r" * 32, 7)
+        tr.stamp(key, "echoed")
+        assert tr.live == 1
+        assert r.counter("tx_traced").value == 0
+        assert r.counter("tx_trace_relayed").value == 1
+        tr.stamp(key, "committed")
+        (rec,) = tr.tracez()["completed"]
+        assert rec["origin"] is False
+        assert [s[0] for s in rec["stages"]] == ["echoed", "committed"]
+        snap = tr.snapshot()
+        assert snap["ingress_to_committed"]["count"] == 0
+
+    def test_relay_lottery_is_key_based(self):
+        # sample_every=2: relay records open for the same HALF of the
+        # key space on every node (key-hash, not arrival order), so
+        # sampled spans join across the fleet
+        r = Registry()
+        tr = TxTrace(r, sample_every=2)
+        for seq in range(1, 9):
+            tr.stamp((bytes([0]) * 32, seq), "echoed")
+        assert tr.live == 4  # even (0 + seq) % 2 == 0 keys only
+        assert r.counter("tx_trace_relayed").value == 4
+
+
+# ------------------------------------------------------- flight recorder
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_with_drop_accounting(self):
+        rec = FlightRecorder(cap=4)
+        for i in range(10):
+            rec.record("rx", (i,))
+        d = rec.dump()
+        assert d["cap"] == 4 and d["recorded"] == 10 and d["dropped"] == 6
+        assert len(d["events"]) == 4
+        # ring keeps the NEWEST cap events, oldest first
+        assert [e[2][0] for e in d["events"]] == [6, 7, 8, 9]
+        assert [e[1] for e in d["events"]] == ["rx"] * 4
+        # paired clock readings for wall alignment at the consumer
+        assert d["now_monotonic"] > 0 and d["now_wall"] > 0
+
+    def test_cap_zero_disables(self):
+        rec = FlightRecorder(cap=0)
+        assert not rec.enabled
+        rec.record("rx", (1,))
+        rec.snapshot("anomaly")
+        d = rec.dump()
+        assert d["recorded"] == 0 and d["events"] == []
+        assert d["snapshots"] == []
+
+    def test_snapshots_survive_rollover_and_stay_bounded(self):
+        rec = FlightRecorder(cap=2, max_snapshots=2)
+        rec.record("a", (1,))
+        rec.snapshot("first")
+        for i in range(5):
+            rec.record("b", (i,))
+        # the frozen copy still shows the pre-rollover ring
+        d = rec.dump()
+        assert len(d["snapshots"]) == 1
+        assert [e[1] for e in d["snapshots"][0]["events"]] == ["a"]
+        # a flapping anomaly cannot grow the snapshot list unboundedly
+        for n in range(5):
+            rec.snapshot(f"flap{n}")
+        d = rec.dump()
+        assert len(d["snapshots"]) == 2
+        assert rec.snapshots_taken == 6
+        assert [s["reason"] for s in d["snapshots"]] == ["flap3", "flap4"]
+
+    def test_thread_safety_exact_total(self):
+        rec = FlightRecorder(cap=256)
+        n_threads, per = 8, 500
+
+        def hammer(t):
+            for i in range(per):
+                rec.record("t", (t, i))
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        d = rec.dump()
+        assert d["recorded"] == n_threads * per
+        assert len(d["events"]) == 256
+
+    def test_bad_params_rejected(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(cap=-1)
+        with pytest.raises(ValueError):
+            FlightRecorder(max_snapshots=0)
+
+
+# ------------------------------------------------- stitch + tail (pure)
+
+
+class TestTraceTools:
+    def _dump(self, node, records):
+        return {"node": node, "live": [], "completed": records}
+
+    def _rec(self, seq, origin, stages, terminal="committed"):
+        return {
+            "sender": "aa" * 32,
+            "seq": seq,
+            "origin": origin,
+            "terminal": terminal,
+            "stages": stages,
+        }
+
+    def test_stitch_joins_and_attributes_stragglers(self):
+        from at2_node_tpu.tools.trace_collect import stitch
+
+        origin = self._dump("n0", [self._rec(
+            1, True,
+            [["ingress", 0.0, 100.0], ["echoed", 0.01, 100.01],
+             ["committed", 0.05, 100.05]],
+        )])
+        relay = self._dump("n1", [self._rec(
+            1, False,
+            [["echoed", 7.02, 100.02], ["committed", 7.09, 100.09]],
+        )])
+        st = stitch([relay, origin])  # polling order must not matter
+        assert st["coverage"] == {
+            "txs": 1, "committed": 1,
+            "stitched_committed": 1, "with_origin": 1,
+        }
+        (tx,) = st["txs"]
+        assert tx["origin_node"] == "n0" and tx["nodes"] == 2
+        # times normalize to the ORIGIN ingress wall stamp (t=0)
+        n1 = [s for s in tx["spans"] if s["node"] == "n1"][0]
+        assert n1["stages"] == [["echoed", 0.02], ["committed", 0.09]]
+        # n1 was last into both stages: it is the straggler
+        assert tx["stragglers"]["committed"] == ["n1", 0.09]
+        assert st["straggler_counts"]["echoed"] == {"n1": 1}
+        # pure: same dumps in, byte-identical JSON out
+        assert json.dumps(st, sort_keys=True) == json.dumps(
+            stitch([relay, origin]), sort_keys=True
+        )
+
+    def test_chrome_trace_shape(self):
+        from at2_node_tpu.tools.trace_collect import chrome_trace, stitch
+
+        st = stitch([self._dump("n0", [self._rec(
+            1, True,
+            [["ingress", 0.0, 100.0], ["committed", 0.05, 100.05]],
+        )])])
+        ev = chrome_trace(st)["traceEvents"]
+        (x,) = [e for e in ev if e["ph"] == "X"]
+        assert x["name"] == "ingress→committed"
+        assert x["ts"] == 0 and x["dur"] == 50_000  # µs
+        assert any(e["ph"] == "M" for e in ev)  # process/thread names
+        assert any(e["ph"] == "i" for e in ev)  # terminal instant
+
+    def test_top_tracez_tail_dedups(self):
+        from at2_node_tpu.tools.top import render_trace_lines
+
+        dump = self._dump("n0", [self._rec(
+            3, True,
+            [["ingress", 0.0, 100.0], ["committed", 0.05, 100.05]],
+        )])
+        seen: set = set()
+        first = render_trace_lines("127.0.0.1:7001", dump, seen)
+        assert len(first) == 1
+        assert "committed" in first[0] and "50.00" in first[0]
+        # second poll with the same ring: nothing new to print
+        assert render_trace_lines("127.0.0.1:7001", dump, seen) == []
 
 
 # ----------------------------------------------------- endpoints over mux
@@ -425,9 +664,68 @@ class TestEndpoints:
         async with _Node(
             observability=ObservabilityConfig(endpoints=False)
         ) as node:
-            for path in ("/metrics", "/healthz", "/statusz"):
+            for path in (
+                "/metrics", "/healthz", "/statusz", "/tracez", "/debugz",
+            ):
                 status, _, _ = await _get(node.config.rpc_address, path)
                 assert status == 404
+
+    async def test_tracez_and_debugz_after_commit(self):
+        async with _Node() as node:
+            addr = node.config.rpc_address
+            async with Client(f"http://{addr}") as client:
+                sender = SignKeyPair.random()
+                await client.send_asset(
+                    sender, 1, SignKeyPair.random().public, 5
+                )
+                deadline = asyncio.get_event_loop().time() + TIMEOUT
+                while await client.get_last_sequence(sender.public) != 1:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(TICK)
+
+            # /tracez: the committed tx sits in the completed ring with
+            # the full stage ladder and a paired clock reading
+            status, headers, body = await _get(addr, "/tracez")
+            assert status == 200
+            assert headers["content-type"].startswith("application/json")
+            z = json.loads(body)
+            assert set(z) >= {"node", "clock", "live", "completed"}
+            (rec,) = [
+                r_ for r_ in z["completed"]
+                if r_["sender"] == sender.public.hex()
+            ]
+            assert rec["origin"] is True
+            assert rec["terminal"] == "committed"
+            stages = [s[0] for s in rec["stages"]]
+            assert stages[0] == "ingress" and stages[-1] == "committed"
+
+            # ?limit= bounds the completed list (0 = none)
+            status, _, body = await _get(addr, "/tracez?limit=0")
+            assert status == 200
+            assert json.loads(body)["completed"] == []
+
+            # /debugz: the flight-recorder ring saw the protocol run
+            status, headers, body = await _get(addr, "/debugz")
+            assert status == 200
+            assert headers["content-type"].startswith("application/json")
+            d = json.loads(body)
+            rec = d["recorder"]
+            assert rec["cap"] == 2048 and rec["recorded"] > 0
+            codes = {e[1] for e in rec["events"]}
+            # single node, default (batched) plane: the slot crossed
+            # its echo decision and ready-quorum delivery edge, and the
+            # attestation send path fired
+            assert {"batch_echo", "batch_deliver", "tx"} <= codes
+
+    async def test_recorder_disabled_by_cap_zero(self):
+        async with _Node(
+            observability=ObservabilityConfig(recorder_cap=0)
+        ) as node:
+            addr = node.config.rpc_address
+            assert not node.service.recorder.enabled
+            status, _, body = await _get(addr, "/debugz")
+            assert status == 200
+            assert json.loads(body)["recorder"]["recorded"] == 0
 
     async def test_snapshot_stats_key_set_stable(self):
         # the registry view must not grow/shrink keys between scrapes
