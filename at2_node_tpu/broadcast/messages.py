@@ -48,6 +48,13 @@ BATCH = 9  # a node-originated batch of client payloads (gossip unit)
 BATCH_ECHO = 10  # Echo over a batch: endorsement bitmap + one signature
 BATCH_READY = 11  # Ready over a batch: same shape as BATCH_ECHO
 BATCH_REQ = 12  # content pull for a quorate batch never gossiped here
+# Client-directory gossip (broker ingress tier, see node/directory.py):
+# a node that assigned client-ids announces the id -> pubkey mappings to
+# its peers so distilled batches resolve everywhere. Liveness-only state
+# (a wrong mapping just fails the entry's signature check locally), so
+# announces are unsigned and accepted only over authenticated channels,
+# same trust shape as the catchup plane.
+DIR_ANNOUNCE = 13  # (announcing node, [(client_id, pubkey)...])
 
 _PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
 _ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
@@ -59,6 +66,8 @@ _HIST_REQ = struct.Struct("<Q32sII")  # nonce, sender, from_seq, to_seq
 _BATCH_HDR = struct.Struct("<32sQI64s")  # origin, batch_seq, count, origin sig
 _BATCH_ATT = struct.Struct("<32s32sQ32sI")  # origin, b_origin, b_seq, hash, bm len
 _BATCH_REQ = struct.Struct("<32sQ32s")  # batch origin, batch_seq, hash
+_DIR_HDR = struct.Struct("<32sI")  # announcing node, entry count
+_DIR_ENTRY = struct.Struct("<Q32s")  # client id, client pubkey
 
 PAYLOAD_WIRE = 1 + _PAYLOAD.size
 ATTEST_WIRE = 1 + _ATTEST.size
@@ -70,6 +79,11 @@ ENTRY_WIRE = _PAYLOAD.size  # one batch entry = one 140-byte payload body
 BATCH_HDR_WIRE = 1 + _BATCH_HDR.size  # variable: header + count entries
 BATCH_ATT_WIRE = 1 + _BATCH_ATT.size + 64  # variable: + bitmap before sig
 BATCH_REQ_WIRE = 1 + _BATCH_REQ.size
+DIR_HDR_WIRE = 1 + _DIR_HDR.size  # variable: header + count entries
+
+# Bounds one announce's parse amplification (a full directory re-sync
+# splits across several announces).
+MAX_DIR_ENTRIES = 4096
 
 # Hard cap on entries per batch (bounds bitmap width, parse amplification,
 # and the per-slot verify burst); the ingress batcher flushes well below
@@ -489,6 +503,35 @@ class BatchContentRequest:
         return BatchContentRequest(b_origin, b_seq, b_hash)
 
 
+@dataclass(frozen=True)
+class DirectoryAnnounce:
+    """Gossiped client-directory mappings: ``entries`` is a tuple of
+    (client_id, pubkey) pairs assigned by ``origin`` (ids must fall in
+    origin's stride — receivers check, node/directory.py ``apply``).
+    Unsigned: accepted only over the mesh's authenticated channels, and
+    a byzantine peer announcing wrong mappings can only make entries
+    fail signature verification locally (liveness, never safety)."""
+
+    origin: bytes  # announcing node's sign key
+    entries: tuple  # of (client_id: int, pubkey: bytes)
+
+    def encode(self) -> bytes:
+        parts = [
+            bytes([DIR_ANNOUNCE]),
+            _DIR_HDR.pack(self.origin, len(self.entries)),
+        ]
+        parts.extend(_DIR_ENTRY.pack(cid, key) for cid, key in self.entries)
+        return b"".join(parts)
+
+    @staticmethod
+    def decode_body(origin: bytes, body: bytes) -> "DirectoryAnnounce":
+        n = len(body) // _DIR_ENTRY.size
+        entries = tuple(
+            _DIR_ENTRY.unpack_from(body, i * _DIR_ENTRY.size) for i in range(n)
+        )
+        return DirectoryAnnounce(origin, entries)
+
+
 def parse_frame(frame: bytes) -> list:
     """Split a frame into messages (frames may coalesce many)."""
     out = []
@@ -572,6 +615,19 @@ def parse_frame(frame: bytes) -> list:
                 BatchContentRequest.decode_body(bytes(view[1:BATCH_REQ_WIRE]))
             )
             view = view[BATCH_REQ_WIRE:]
+        elif kind == DIR_ANNOUNCE:
+            if len(view) < DIR_HDR_WIRE:
+                raise WireError("truncated directory announce header")
+            origin, count = _DIR_HDR.unpack(bytes(view[1:DIR_HDR_WIRE]))
+            if count > MAX_DIR_ENTRIES:
+                raise WireError("directory announce entry count out of range")
+            total = DIR_HDR_WIRE + count * _DIR_ENTRY.size
+            if len(view) < total:
+                raise WireError("truncated directory announce entries")
+            out.append(
+                DirectoryAnnounce.decode_body(origin, bytes(view[DIR_HDR_WIRE:total]))
+            )
+            view = view[total:]
         else:
             raise WireError(f"unknown message kind {kind}")
     return out

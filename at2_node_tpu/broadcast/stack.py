@@ -103,6 +103,7 @@ from .messages import (
     BatchAttestation,
     BatchContentRequest,
     ContentRequest,
+    DirectoryAnnounce,
     HistoryBatch,
     HistoryIndex,
     HistoryIndexRequest,
@@ -472,6 +473,10 @@ class Broadcast:
         # node-service hook for catchup-plane messages (sync callable
         # (peer, msg) -> None); None drops them (a stack used standalone)
         self.catchup_handler = None
+        # node-service hook for client-directory announces (sync callable
+        # (peer, msg) -> None; node/directory.py) — same routing shape as
+        # the catchup plane; None drops them (a stack used standalone)
+        self.directory_handler = None
         # node-service hook fired (once per GC pass) when some slot has
         # been stalled past STALLED_CATCHUP_AFTER: push-retransmission
         # has failed, recovery belongs to the ledger-catchup plane.
@@ -908,6 +913,15 @@ class Broadcast:
                         self.catchup_handler(peer, msg)
                     except Exception:
                         logger.exception("catchup handler error")
+            elif isinstance(msg, DirectoryAnnounce):
+                # directory mappings are liveness-only service state
+                # (node/directory.py); synchronous apply, bad mappings
+                # are dropped by the handler's stride/conflict checks
+                if self.directory_handler is not None and peer is not None:
+                    try:
+                        self.directory_handler(peer, msg)
+                    except Exception:
+                        logger.exception("directory handler error")
             else:
                 if self._pre_attestation(msg, peer):
                     to_verify.append((msg.origin, msg.to_sign(), msg.signature))

@@ -1,0 +1,336 @@
+"""Untrusted broker ingress tier (Chop Chop-style batch distillation).
+
+The broker sits between clients and a node and converts many small
+per-transfer submissions into few distilled `SendDistilledBatch` frames
+(proto/distill.py): sorted delta-coded client-ids, deduped senders,
+columnar signatures. It serves the same `at2.AT2` gRPC surface a node
+does, so existing clients point at a broker unmodified — submissions are
+collected, everything else proxies through to the node.
+
+Trust argument (TECHNICAL.md "Directory & broker ingress"): the broker
+is OUTSIDE the trust boundary. Every entry it forwards is still signed
+by its client over the canonical ThinTransaction bytes, and the node
+verifies per entry against the gossiped directory — a byzantine broker
+can withhold, reorder, or duplicate entries (liveness, bounded by the
+node's dedup memory and per-client admission), but can never forge a
+transfer or shift blame for bad signatures onto other clients: admission
+buckets at the node are keyed by CLIENT id, not broker identity.
+
+The broker auto-registers unknown sender keys via the node's `Register`
+RPC and compresses recipient keys to directory ids when it knows them,
+so a warmed-up broker emits near-minimal frames.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional
+
+import grpc
+
+from .client import _target
+from .crypto.keys import SignKeyPair  # noqa: F401  (re-export for runners)
+from .net.webmux import PortMux
+from .obs.registry import Registry
+from .proto import at2_pb2 as pb
+from .proto import distill
+from .proto.rpc import At2Servicer, At2Stub, add_to_server
+
+logger = logging.getLogger(__name__)
+
+# Entries buffered while the node is unreachable or the builder lags.
+# Beyond the cap new submissions are refused (RESOURCE_EXHAUSTED) — an
+# unbounded buffer would turn a dead node into broker OOM.
+PENDING_CAP = 1 << 16
+
+
+class Broker(At2Servicer):
+    """One broker. `await Broker.start(...)`, then `serve_forever`."""
+
+    def __init__(
+        self,
+        node_uri: str,
+        *,
+        max_entries: int = distill.DISTILL_MAX_ENTRIES,
+        window: float = 0.005,
+        clock=None,
+    ) -> None:
+        from .clock import SYSTEM_CLOCK
+
+        if not (1 <= max_entries <= distill.DISTILL_MAX_ENTRIES):
+            raise ValueError(
+                f"max_entries must be in [1, {distill.DISTILL_MAX_ENTRIES}]"
+            )
+        self.node_uri = node_uri
+        self.max_entries = max_entries
+        self.window = window
+        self.clock = SYSTEM_CLOCK if clock is None else clock
+        self._channel = grpc.aio.insecure_channel(_target(node_uri))
+        self._stub = At2Stub(self._channel)
+        self._ids: Dict[bytes, int] = {}  # pubkey -> directory client-id
+        self._buf: List[distill.DistilledEntry] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self._closing = False
+        self._grpc_server: Optional[grpc.aio.Server] = None
+        self._mux: Optional[PortMux] = None
+        self._started_at = self.clock.monotonic()
+
+        self.registry = Registry()
+        self.stats = self.registry.counter_group(
+            (
+                "broker_entries_rx",  # transfers accepted into the buffer
+                "broker_entries_tx",  # transfers forwarded inside frames
+                "broker_batches_tx",  # distilled frames forwarded
+                "broker_dedup_drops",  # (id, seq) dups dropped at build
+                "broker_overflow_drops",  # refused: buffer at PENDING_CAP
+                "broker_forward_errors",  # SendDistilledBatch RPC failures
+                "broker_registrations",  # Register round-trips to the node
+            )
+        )
+        # seconds from flush trigger to frame handed to the RPC stack:
+        # the distillation cost a broker adds over direct submission
+        self.h_build = self.registry.histogram(
+            "broker_build_latency", "distilled frame build seconds"
+        )
+        self.registry.gauge(
+            "broker_pending", "entries buffered awaiting a flush",
+            fn=lambda: len(self._buf),
+        )
+        self.registry.gauge(
+            "broker_directory_known", "client ids cached from Register",
+            fn=lambda: len(self._ids),
+        )
+        self.registry.register_provider(
+            "rpc_",
+            lambda: self._mux.stats() if self._mux is not None else {},
+        )
+
+    # -- lifecycle --------------------------------------------------------
+
+    @staticmethod
+    async def start(
+        node_uri: str,
+        listen: str,
+        *,
+        max_entries: int = distill.DISTILL_MAX_ENTRIES,
+        window: float = 0.005,
+        clock=None,
+    ) -> "Broker":
+        """Bring up a broker serving `at2.AT2` on ``listen`` (same
+        PortMux surface as a node: native gRPC + grpc-web + GET
+        /metrics), collecting for the node at ``node_uri``."""
+        broker = Broker(
+            node_uri, max_entries=max_entries, window=window, clock=clock
+        )
+        try:
+            server = grpc.aio.server()
+            add_to_server(broker, server)
+            broker._grpc_server = server
+            internal_port = server.add_insecure_port("127.0.0.1:0")
+            if internal_port == 0:
+                raise OSError("cannot bind internal grpc port")
+            await server.start()
+            broker._mux = PortMux(listen, internal_port, broker)
+            try:
+                await broker._mux.start()
+            except OSError as exc:
+                raise OSError(f"cannot bind broker address {listen}") from exc
+        except BaseException:
+            await broker.close()
+            raise
+        logger.info("broker up: rpc on %s -> node %s", listen, node_uri)
+        return broker
+
+    async def serve_forever(self) -> None:
+        await self._grpc_server.wait_for_termination()
+
+    async def close(self) -> None:
+        self._closing = True
+        if self._mux is not None:
+            await self._mux.close()
+        if self._grpc_server is not None:
+            try:
+                await self._grpc_server.stop(grace=0.5)
+            except Exception:
+                logger.exception("broker grpc server stop failed")
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            try:
+                await self._flush_task
+            except asyncio.CancelledError:
+                pass
+        # best-effort final flush: like a node's ingress buffer, ACKed
+        # submissions are not commit receipts and may drop on shutdown,
+        # but draining what we can costs one RPC
+        if self._buf:
+            try:
+                await self._flush()
+            except Exception:
+                logger.exception("broker final flush failed")
+        await self._channel.close()
+
+    # -- observability (PortMux GET surface, duck-typed) ------------------
+
+    _OBS_JSON = "application/json; charset=utf-8"
+    _OBS_PROM = "text/plain; version=0.0.4; charset=utf-8"
+
+    def obs_http(self, path: str):
+        route, _, _query = path.partition("?")
+        if route == "/metrics":
+            return 200, self._OBS_PROM, self.registry.render_prometheus().encode()
+        if route == "/healthz":
+            verdict = {
+                "status": "closing" if self._closing else "ok",
+                "role": "broker",
+                "node": self.node_uri,
+                "pending": len(self._buf),
+                "uptime_s": round(
+                    self.clock.monotonic() - self._started_at, 3
+                ),
+            }
+            status = 200 if not self._closing else 503
+            return status, self._OBS_JSON, json.dumps(verdict, sort_keys=True).encode()
+        if route == "/statusz":
+            body = json.dumps(
+                {"role": "broker", "stats": self.registry.snapshot()},
+                sort_keys=True,
+                default=float,
+            ).encode()
+            return 200, self._OBS_JSON, body
+        return None
+
+    # -- collection -------------------------------------------------------
+
+    async def _client_id(self, pubkey: bytes) -> int:
+        """The directory id for ``pubkey``, registering it with the node
+        on first sight. Concurrent first-sights race benignly: Register
+        is idempotent on the node, last writer caches the same id."""
+        cid = self._ids.get(pubkey)
+        if cid is None:
+            reply = await self._stub.Register(
+                pb.RegisterRequest(public_key=pubkey)
+            )
+            cid = int(reply.client_id)
+            self._ids[pubkey] = cid
+            self.stats["broker_registrations"] += 1
+        return cid
+
+    async def _collect(self, requests, context) -> None:
+        if self._closing:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, "broker shutting down"
+            )
+        if len(self._buf) + len(requests) > PENDING_CAP:
+            self.stats["broker_overflow_drops"] += len(requests)
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "broker buffer full; node unreachable or lagging",
+            )
+        entries = []
+        for i, req in enumerate(requests):
+            where = f" (entry {i})" if len(requests) > 1 else ""
+            if len(req.sender) != 32 or len(req.recipient) != 32:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"keys must be 32 bytes{where}",
+                )
+            if len(req.signature) != 64:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"signature must be 64 bytes{where}",
+                )
+            cid = await self._client_id(bytes(req.sender))
+            # recipient compression is opportunistic: ids we happen to
+            # know shrink the frame; unknown recipients ride raw (the
+            # node never needs the recipient in its directory)
+            recipient = self._ids.get(bytes(req.recipient), bytes(req.recipient))
+            entries.append(
+                distill.DistilledEntry(
+                    sender_id=cid,
+                    sequence=req.sequence,
+                    recipient=recipient,
+                    amount=req.amount,
+                    signature=bytes(req.signature),
+                )
+            )
+        self._buf.extend(entries)
+        self.stats["broker_entries_rx"] += len(entries)
+        if len(self._buf) >= self.max_entries:
+            await self._flush()
+        elif self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.create_task(self._delayed_flush())
+
+    async def _delayed_flush(self) -> None:
+        while True:
+            await self.clock.sleep(self.window)
+            await self._flush()
+            if not self._buf:
+                return
+
+    async def _flush(self) -> None:
+        """Distill and forward the buffered entries, one frame per
+        max_entries chunk. Snapshot-at-entry like the node's batcher:
+        entries arriving while a forward is awaited wait for their own
+        trigger instead of leaking into this flush."""
+        buf, self._buf = self._buf, []
+        for lo in range(0, len(buf), self.max_entries):
+            chunk = buf[lo : lo + self.max_entries]
+            t0 = self.clock.monotonic()
+            frame, dropped = distill.distill(chunk)
+            self.h_build.observe(self.clock.monotonic() - t0)
+            if dropped:
+                self.stats["broker_dedup_drops"] += dropped
+            try:
+                await self._stub.SendDistilledBatch(
+                    pb.SendDistilledBatchRequest(frame=frame)
+                )
+            except grpc.aio.AioRpcError as exc:
+                # fire-and-forget past this point, like a node dropping
+                # its ingress buffer on shutdown: ACK was never a commit
+                # receipt. The counter (and /metrics) carries the loss.
+                self.stats["broker_forward_errors"] += 1
+                logger.warning(
+                    "distilled forward failed (%s): %s",
+                    exc.code(),
+                    exc.details(),
+                )
+                continue
+            self.stats["broker_batches_tx"] += 1
+            self.stats["broker_entries_tx"] += len(chunk) - dropped
+
+    # -- gRPC surface -----------------------------------------------------
+
+    async def SendAsset(self, request, context):
+        await self._collect([request], context)
+        return pb.SendAssetReply()
+
+    async def SendAssetBatch(self, request, context):
+        if not request.transactions:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "empty batch"
+            )
+        await self._collect(list(request.transactions), context)
+        return pb.SendAssetReply()
+
+    async def Register(self, request, context):
+        """Proxy: clients may pre-register through the broker (warms the
+        broker's id cache as a side effect)."""
+        reply = await self._stub.Register(request)
+        if len(request.public_key) == 32:
+            self._ids[bytes(request.public_key)] = int(reply.client_id)
+        return reply
+
+    async def SendDistilledBatch(self, request, context):
+        """Pass-through: a pre-distilled frame needs no collection."""
+        return await self._stub.SendDistilledBatch(request)
+
+    async def GetBalance(self, request, context):
+        return await self._stub.GetBalance(request)
+
+    async def GetLastSequence(self, request, context):
+        return await self._stub.GetLastSequence(request)
+
+    async def GetLatestTransactions(self, request, context):
+        return await self._stub.GetLatestTransactions(request)

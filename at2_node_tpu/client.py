@@ -105,6 +105,23 @@ class Client:
                 )
             )
 
+    async def register(self, public_key: bytes) -> int:
+        """Register a client pubkey into the node's gossiped directory
+        (broker ingress tier, at2.proto `Register`). Idempotent — returns
+        the same dense client-id on every call."""
+        reply = await self._stub.Register(
+            pb.RegisterRequest(public_key=public_key)
+        )
+        return reply.client_id
+
+    async def send_distilled(self, frame: bytes) -> None:
+        """Submit one distilled batch frame (proto/distill.py format) —
+        the broker's forwarding path; also handy for tests driving the
+        node's distilled ingress directly."""
+        await self._stub.SendDistilledBatch(
+            pb.SendDistilledBatchRequest(frame=frame)
+        )
+
     async def get_balance(self, user: bytes) -> int:
         reply = await self._stub.GetBalance(pb.GetBalanceRequest(sender=user))
         return reply.amount

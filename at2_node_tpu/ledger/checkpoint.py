@@ -30,13 +30,23 @@ logger = logging.getLogger(__name__)
 FORMAT_VERSION = 1
 
 
-async def snapshot(accounts, recent) -> dict:
-    """Collect a consistent point-in-time snapshot of the ledger actors."""
-    return {
+async def snapshot(accounts, recent, directory=None) -> dict:
+    """Collect a consistent point-in-time snapshot of the ledger actors.
+
+    ``directory`` (node/directory.py ClientDirectory) rides along when the
+    node runs the broker ingress tier: the id -> pubkey mappings this node
+    assigned or learned survive restarts, so registered clients keep their
+    ids without re-registering. The key is optional — checkpoints written
+    before the directory existed (or by directory-less configs) load fine.
+    """
+    doc = {
         "version": FORMAT_VERSION,
         "accounts": await accounts.export_state(),
         "recent": await recent.export_state(),
     }
+    if directory is not None:
+        doc["directory"] = directory.export()
+    return doc
 
 
 def write_atomic(path: str, doc: dict) -> None:
@@ -71,14 +81,14 @@ def write_atomic(path: str, doc: dict) -> None:
         raise
 
 
-async def save(path: str, accounts, recent) -> None:
-    doc = await snapshot(accounts, recent)
+async def save(path: str, accounts, recent, directory=None) -> None:
+    doc = await snapshot(accounts, recent, directory)
     # serialization + fsync off the event loop: a large ledger must not
     # stall delivery/RPC handling for the duration of a snapshot
     await asyncio.to_thread(write_atomic, path, doc)
 
 
-async def load(path: str, accounts, recent) -> bool:
+async def load(path: str, accounts, recent, directory=None) -> bool:
     """Restore actors from ``path``; returns False when no checkpoint
     exists (fresh start). A corrupt file raises — silently starting from
     genesis after state loss would violate the sequence contract with the
@@ -92,5 +102,7 @@ async def load(path: str, accounts, recent) -> bool:
         raise ValueError(f"unsupported checkpoint version: {doc.get('version')}")
     await accounts.import_state(doc["accounts"])
     await recent.import_state(doc["recent"])
+    if directory is not None:
+        directory.import_(doc.get("directory", ()))
     logger.info("restored checkpoint %s (%d accounts)", path, len(doc["accounts"]))
     return True

@@ -158,6 +158,7 @@ void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
 constexpr uint8_t kGossip = 1, kEcho = 2, kReady = 3, kRequest = 4;
 constexpr uint8_t kHistIdxReq = 5, kHistIdx = 6, kHistReq = 7, kHistBatch = 8;
 constexpr uint8_t kBatch = 9, kBatchEcho = 10, kBatchReady = 11, kBatchReq = 12;
+constexpr uint8_t kDirAnnounce = 13;
 constexpr size_t kPayloadWire = 1 + 140;
 constexpr size_t kAttestWire = 1 + 164;
 constexpr size_t kRequestWire = 1 + 68;
@@ -179,6 +180,10 @@ constexpr size_t kBatchAttWire = 1 + 108 + 64;  // + bitmap between hdr/sig
 constexpr size_t kBatchReqWire = 1 + 72;
 constexpr uint64_t kMaxBatchEntries = 1024;  // messages.MAX_BATCH_ENTRIES
 constexpr uint64_t kMaxBitmapBytes = kMaxBatchEntries / 8;
+// DIR_ANNOUNCE = 0x0d | origin(32) count(u32) count*(id(u64) pubkey(32))
+constexpr size_t kDirHdrWire = 1 + 36;
+constexpr size_t kDirEntry = 40;
+constexpr uint64_t kMaxDirEntries = 4096;  // messages.MAX_DIR_ENTRIES
 constexpr size_t kMinWire = kHistIdxReqWire;  // smallest message on the wire
 // A legitimate frame coalesces at most MAX_BATCH_MSGS = 1024 messages
 // (net/peers.py); 4x that is the malformed-frame bound. Without it a
@@ -204,6 +209,40 @@ constexpr size_t kRowStride = 176;  // 173 rounded up for alignment
 
 inline void put_le64(uint8_t* p, uint64_t v) {
   for (int i = 0; i < 8; i++) p[i] = uint8_t(v >> (8 * i));
+}
+
+inline void put_le32(uint8_t* p, uint32_t v) {
+  for (int i = 0; i < 4; i++) p[i] = uint8_t(v >> (8 * i));
+}
+
+// ---------------- distilled frames (proto/distill.py reference) --------
+
+constexpr uint8_t kDistillMagic = 0xD5, kDistillVersion = 0x01;
+constexpr uint64_t kDistillMaxEntries = 4096;  // distill.DISTILL_MAX_ENTRIES
+constexpr size_t kEntryWire = 140;
+constexpr size_t kSigWire = 64;
+
+// LEB128 u64 with exactly distill._read_varint's acceptance set: up to
+// 10 bytes, values <= 2^64-1, non-minimal encodings allowed (the Python
+// and native decoders must accept/reject identical byte strings — they
+// are differential-tested in tests/test_distill.py).
+inline bool read_varint(const uint8_t* buf, size_t len, size_t& off,
+                        uint64_t& out) {
+  uint64_t result = 0;
+  int shift = 0;
+  for (int i = 0; i < 10; i++) {
+    if (off >= len) return false;
+    uint8_t b = buf[off++];
+    uint64_t bits = uint64_t(b & 0x7F);
+    if (shift == 63 && bits > 1) return false;  // > 2^64-1
+    result |= bits << shift;
+    if (!(b & 0x80)) {
+      out = result;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;  // longer than 10 bytes
 }
 
 }  // namespace
@@ -251,6 +290,11 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
         wire = kBatchAttWire + size_t(bm_len);
       } else if (kind == kBatchReq) {
         wire = kBatchReqWire;
+      } else if (kind == kDirAnnounce) {
+        if (left < kDirHdrWire) { ok = false; break; }
+        uint64_t count = le32(p + 1 + 32);
+        if (count > kMaxDirEntries) { ok = false; break; }
+        wire = kDirHdrWire + size_t(count) * kDirEntry;
       } else { ok = false; break; }
       if (left < wire) { ok = false; break; }
       if (n_out - start >= kMaxMsgsPerFrame) { ok = false; break; }
@@ -258,7 +302,7 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
       uint8_t* row = rows + n_out * kRowStride;
       row[0] = kind;
       if (kind == kHistIdx || kind == kHistBatch || kind == kBatch ||
-          kind == kBatchEcho || kind == kBatchReady) {
+          kind == kBatchEcho || kind == kBatchReady || kind == kDirAnnounce) {
         // variable-length kinds: row carries (offset, length) into `flat`
         put_le64(row + 1, uint64_t(p + 1 - flat));
         put_le64(row + 9, uint64_t(wire - 1));
@@ -342,6 +386,106 @@ void at2_verify_bulk(const uint8_t* pk_flat, const uint64_t* pk_off,
     threads.emplace_back(worker, lo, hi);
   }
   for (auto& th : threads) th.join();
+}
+
+// Distilled-frame bulk parse + expansion (the broker ingress fast path;
+// proto/distill.py documents the wire format and is the reference
+// decoder). One GIL-released pass: decode the varint/delta head, resolve
+// sender/recipient client-ids against the directory table (`dir_keys` =
+// dir_count x 32 contiguous rows, an all-zero row means unassigned), and
+// expand every entry to its 140-byte canonical GOSSIP body — exactly the
+// `entries_raw` bytes TxBatch carries — with the columnar signature
+// copied in. No per-entry Python objects are ever built on this path.
+//
+// Returns the entry count, or -1 on any malformation (same acceptance
+// set as distill.decode). Per entry i: out_ids[i] = sender client-id,
+// out_ok[i] = 1 iff both sender and recipient ids resolved (misses zero
+// the unresolved field; the caller counts them as directory_misses and
+// drops the entry before verification).
+int64_t at2_distill_parse(const uint8_t* frame, int64_t frame_len,
+                          const uint8_t* dir_keys, int64_t dir_count,
+                          uint8_t* out_bodies, uint64_t* out_ids,
+                          uint8_t* out_ok, int64_t cap) {
+  static const uint8_t kZero32[32] = {0};
+  if (frame_len < 4) return -1;
+  size_t len = size_t(frame_len);
+  if (frame[0] != kDistillMagic || frame[1] != kDistillVersion) return -1;
+  size_t off = 2;
+  uint64_t n_groups, n_entries;
+  if (!read_varint(frame, len, off, n_groups)) return -1;
+  if (!read_varint(frame, len, off, n_entries)) return -1;
+  if (n_groups == 0 || n_entries == 0) return -1;
+  if (n_entries > kDistillMaxEntries || n_groups > n_entries) return -1;
+  if (int64_t(n_entries) > cap) return -1;
+  uint64_t sig_len = n_entries * kSigWire;
+  if (len < off + sig_len) return -1;
+  size_t sig_start = len - size_t(sig_len);
+
+  auto resolve = [&](uint64_t id) -> const uint8_t* {
+    if (id >= uint64_t(dir_count)) return nullptr;
+    const uint8_t* row = dir_keys + size_t(id) * 32;
+    if (std::memcmp(row, kZero32, 32) == 0) return nullptr;
+    return row;
+  };
+
+  int64_t n_out = 0;
+  uint64_t prev_id = 0;
+  bool first_group = true;
+  for (uint64_t g = 0; g < n_groups; g++) {
+    uint64_t delta, gid;
+    if (!read_varint(frame, len, off, delta)) return -1;
+    if (first_group) {
+      gid = delta;
+      first_group = false;
+    } else {
+      if (delta == 0) return -1;  // ids not strictly increasing
+      if (delta > UINT64_MAX - prev_id) return -1;  // id exceeds u64
+      gid = prev_id + delta;
+    }
+    prev_id = gid;
+    uint64_t n;
+    if (!read_varint(frame, len, off, n)) return -1;
+    if (n == 0 || uint64_t(n_out) + n > n_entries) return -1;
+    const uint8_t* sender = resolve(gid);
+    uint64_t prev_seq = 0;
+    for (uint64_t e = 0; e < n; e++) {
+      uint64_t sd;
+      if (!read_varint(frame, len, off, sd)) return -1;
+      if (sd == 0) return -1;  // seqs not strictly increasing
+      uint64_t seq = prev_seq + sd;
+      if (seq > 0xFFFFFFFFULL) return -1;  // sequence exceeds u32
+      prev_seq = seq;
+      uint64_t rtag;
+      if (!read_varint(frame, len, off, rtag)) return -1;
+      const uint8_t* recipient;
+      bool recipient_ok;
+      if (rtag == 0) {
+        if (off + 32 > sig_start) return -1;  // truncated raw recipient
+        recipient = frame + off;
+        recipient_ok = true;
+        off += 32;
+      } else {
+        recipient = resolve(rtag - 1);
+        recipient_ok = recipient != nullptr;
+      }
+      uint64_t amount;
+      if (!read_varint(frame, len, off, amount)) return -1;
+      if (off > sig_start) return -1;  // head overruns signature block
+      uint8_t* body = out_bodies + size_t(n_out) * kEntryWire;
+      std::memcpy(body, sender != nullptr ? sender : kZero32, 32);
+      put_le32(body + 32, uint32_t(seq));
+      std::memcpy(body + 36, recipient != nullptr ? recipient : kZero32, 32);
+      put_le64(body + 68, amount);
+      std::memcpy(body + 76, frame + sig_start + size_t(n_out) * kSigWire,
+                  kSigWire);
+      out_ids[n_out] = gid;
+      out_ok[n_out] = (sender != nullptr && recipient_ok) ? 1 : 0;
+      n_out++;
+    }
+  }
+  if (uint64_t(n_out) != n_entries) return -1;
+  if (off != sig_start) return -1;  // trailing bytes before signatures
+  return n_out;
 }
 
 }  // extern "C"

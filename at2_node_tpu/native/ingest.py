@@ -29,6 +29,7 @@ from ..broadcast.messages import (
     BATCH_ECHO,
     BATCH_READY,
     BATCH_REQ,
+    DIR_ANNOUNCE,
     ECHO,
     GOSSIP,
     HIST_BATCH,
@@ -38,11 +39,13 @@ from ..broadcast.messages import (
     MAX_MSGS_PER_FRAME,
     READY,
     REQUEST,
+    _DIR_HDR,
     _HIST_HDR,
     Attestation,
     BatchAttestation,
     BatchContentRequest,
     ContentRequest,
+    DirectoryAnnounce,
     HistoryBatch,
     HistoryIndex,
     HistoryIndexRequest,
@@ -92,6 +95,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.at2_ingest_row_stride.restype = ctypes.c_int64
         lib.at2_ingest_min_wire.argtypes = []
         lib.at2_ingest_min_wire.restype = ctypes.c_int64
+        lib.at2_distill_parse.argtypes = [
+            U8P, ctypes.c_int64, U8P, ctypes.c_int64,
+            U8P, U64P, U8P, ctypes.c_int64,
+        ]
+        lib.at2_distill_parse.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -208,7 +216,9 @@ def parse_frames_native(frames: Sequence[bytes]):
             msg = HistoryRequest.decode_body(row_bytes[base + 1 : base + 49])
         elif kind == BATCH_REQ:
             msg = BatchContentRequest.decode_body(row_bytes[base + 1 : base + 73])
-        elif kind in (HIST_IDX, HIST_BATCH, BATCH, BATCH_ECHO, BATCH_READY):
+        elif kind in (
+            HIST_IDX, HIST_BATCH, BATCH, BATCH_ECHO, BATCH_READY, DIR_ANNOUNCE
+        ):
             # variable-length rows carry (offset, length) into `flat`
             off = int.from_bytes(row_bytes[base + 1 : base + 9], "little")
             ln = int.from_bytes(row_bytes[base + 9 : base + 17], "little")
@@ -217,6 +227,9 @@ def parse_frames_native(frames: Sequence[bytes]):
                 msg = TxBatch.decode_body(body)
             elif kind in (BATCH_ECHO, BATCH_READY):
                 msg = BatchAttestation.decode_body(kind, body)
+            elif kind == DIR_ANNOUNCE:
+                origin, _count = _DIR_HDR.unpack_from(body)
+                msg = DirectoryAnnounce.decode_body(origin, body[_DIR_HDR.size :])
             else:
                 nonce, _count = _HIST_HDR.unpack_from(body)
                 if kind == HIST_IDX:
@@ -227,6 +240,39 @@ def parse_frames_native(frames: Sequence[bytes]):
             continue
         out.append((frame_idx[i], msg))
     return out, frame_ok.astype(bool)
+
+
+def distill_parse_native(
+    frame: bytes, dir_keys: np.ndarray, dir_count: int
+) -> Optional[Tuple[bytes, np.ndarray, np.ndarray]]:
+    """Parse + expand one distilled frame (proto/distill.py format) in a
+    single GIL-released native call, resolving client-ids against the
+    directory's ``(cap, 32)`` uint8 key table.
+
+    Returns ``(bodies, sender_ids, ok)`` — ``bodies`` is ``n * 140``
+    canonical entry bytes (TxBatch ``entries_raw`` layout), ``ok[i]``
+    False marks a directory miss — or ``None`` when the frame is
+    malformed (same acceptance set as ``distill.decode``; differential-
+    tested in tests/test_distill.py)."""
+    lib = _load()
+    assert lib is not None, "call ingest_available() first"
+    from ..proto.distill import DISTILL_MAX_ENTRIES, ENTRY_WIRE
+
+    buf = np.frombuffer(frame, dtype=np.uint8)
+    cap = DISTILL_MAX_ENTRIES
+    bodies = np.zeros(cap * ENTRY_WIRE, dtype=np.uint8)
+    ids = np.zeros(cap, dtype=np.uint64)
+    ok = np.zeros(cap, dtype=np.uint8)
+    assert dir_keys.dtype == np.uint8 and dir_keys.flags["C_CONTIGUOUS"]
+    n = int(
+        lib.at2_distill_parse(
+            ptr8(buf), len(frame), ptr8(dir_keys), int(dir_count),
+            ptr8(bodies), ids.ctypes.data_as(U64P), ptr8(ok), cap,
+        )
+    )
+    if n < 0:
+        return None
+    return bodies[: n * ENTRY_WIRE].tobytes(), ids[:n], ok[:n].astype(bool)
 
 
 def verify_bulk_native(

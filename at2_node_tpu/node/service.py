@@ -35,6 +35,7 @@ import grpc
 
 from ..broadcast.messages import (
     MAX_BATCH_ENTRIES,
+    DirectoryAnnounce,
     HistoryBatch,
     HistoryIndex,
     HistoryIndexRequest,
@@ -54,9 +55,11 @@ from ..obs.recorder import FlightRecorder
 from ..obs.registry import Registry
 from ..obs.trace import REJECTED, TxTrace
 from ..proto import at2_pb2 as pb
+from ..proto import distill
 from ..proto.rpc import At2Servicer, add_to_server
 from ..types import ThinTransaction, TransactionState, rfc3339
 from .config import Config
+from .directory import ClientDirectory
 
 logger = logging.getLogger(__name__)
 
@@ -87,6 +90,14 @@ SERVE_ROWS_PER_SEC = 4 * 4096
 # token bucket per gRPC peer string). A source evicted at the cap simply
 # starts a fresh, full bucket — the cap bounds memory, not correctness.
 ADMISSION_SOURCES_CAP = 4096
+
+# Recently-ingested (client_id, sequence) pairs remembered by the
+# distilled-batch path: a byzantine broker can replay an entry across
+# frames (WITHIN a frame duplicates are unrepresentable — the wire's
+# delta coding is strictly increasing). A replay that slips past the cap
+# is still harmless — the ledger's per-account sequence gate rejects it
+# at commit — so this memory only keeps replays off the broadcast plane.
+DISTILL_SEEN_CAP = 1 << 16
 
 
 class _CatchupSession:
@@ -244,6 +255,25 @@ class Service(At2Servicer):
         self.admission_stats = self.registry.counter_group(
             ("rejected_at_ingress", "admission_throttled")
         )
+        # broker ingress tier (node/directory.py, proto/distill.py):
+        # ranks come from the sorted set of ALL node sign keys — every
+        # correctly-configured node derives the same ranking, so id
+        # strides never collide without any coordination round
+        ranked = sorted(
+            [config.sign_key.public] + [p.sign_public for p in config.nodes]
+        )
+        self.directory = ClientDirectory(
+            rank=ranked.index(config.sign_key.public), total=len(ranked)
+        )
+        self._node_ranks = {key: i for i, key in enumerate(ranked)}
+        self._distill_seen: Dict[Tuple[int, int], None] = {}
+        self.distill_stats = self.registry.counter_group(
+            ("distilled_batches_rx", "directory_misses", "dedup_drops")
+        )
+        self.registry.gauge(
+            "directory_size", "client-directory mappings known",
+            fn=lambda: len(self.directory),
+        )
         # commit progress + queue depths as lazy gauges; transport /
         # verifier stats() dicts as prefixed providers — together these
         # make registry.snapshot() reproduce the exact key families the
@@ -318,7 +348,10 @@ class Service(At2Servicer):
         if config.checkpoint.path:
             try:
                 await ckpt.load(
-                    config.checkpoint.path, service.accounts, service.recent
+                    config.checkpoint.path,
+                    service.accounts,
+                    service.recent,
+                    service.directory,
                 )
             except Exception:
                 if service._owns_verifier:
@@ -362,6 +395,7 @@ class Service(At2Servicer):
             ):
                 service.verifier.recorder = service.recorder
             service.broadcast.catchup_handler = service._on_catchup
+            service.broadcast.directory_handler = service._on_directory
             if config.catchup.enabled:
                 # broadcast GC signal: a slot stalled past push-
                 # retransmission recovers via the ledger-catchup plane
@@ -517,7 +551,10 @@ class Service(At2Servicer):
         if self.config.checkpoint.path:
             try:
                 await ckpt.save(
-                    self.config.checkpoint.path, self.accounts, self.recent
+                    self.config.checkpoint.path,
+                    self.accounts,
+                    self.recent,
+                    self.directory,
                 )
             except OSError:
                 logger.exception("final checkpoint failed")
@@ -528,7 +565,7 @@ class Service(At2Servicer):
         while True:
             await self.clock.sleep(interval)
             try:
-                await ckpt.save(path, self.accounts, self.recent)
+                await ckpt.save(path, self.accounts, self.recent, self.directory)
             except OSError:
                 logger.exception("periodic checkpoint failed")
 
@@ -1421,3 +1458,195 @@ class Service(At2Servicer):
                 for tx in txs
             ]
         )
+
+    # -- broker ingress tier (node/directory.py, proto/distill.py) --------
+
+    def _on_directory(self, peer: Peer, msg: DirectoryAnnounce) -> None:
+        """Broadcast-worker hook (synchronous): install gossiped
+        directory mappings. The stride check runs against the CHANNEL
+        peer's rank — authenticated by the mesh handshake — not the
+        frame's origin field, so a byzantine peer can only announce into
+        its own id stride (and even there, only poison liveness: wrong
+        keys just fail entry signature verification locally)."""
+        rank = self._node_ranks.get(peer.sign_public)
+        if rank is None:
+            return
+        for client_id, pubkey in msg.entries:
+            self.directory.apply(client_id, pubkey, rank=rank)
+
+    async def Register(self, request, context):
+        """Directory registration (at2.proto): assign — or look up — the
+        dense client-id for a pubkey and announce the mapping to peers.
+        The announce goes out on EVERY call, not just first assignment: a
+        client retrying Register doubles as a gossip repair for mappings
+        peers may have missed."""
+        key = bytes(request.public_key)
+        if len(key) != 32 or key == b"\x00" * 32:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "public_key must be 32 nonzero bytes",
+            )
+        client_id, _created = self.directory.assign(key)
+        if self.mesh is not None and self.mesh.peers:
+            self.mesh.broadcast(
+                DirectoryAnnounce(
+                    self.config.sign_key.public, ((client_id, key),)
+                ).encode()
+            )
+        return pb.RegisterReply(client_id=client_id)
+
+    def _expand_distilled(self, frame: bytes):
+        """Parse + directory-expand one distilled frame: a single
+        GIL-released native call when the library is ready, the Python
+        reference codec otherwise (identical acceptance set —
+        differential-tested). Returns ``(bodies, ids, ok)`` lists or
+        ``None`` for a malformed frame."""
+        from ..native.ingest import distill_parse_native, ingest_ready_or_kick
+
+        if ingest_ready_or_kick():
+            res = distill_parse_native(frame, *self.directory.keys_view())
+            if res is None:
+                return None
+            bodies, ids_arr, ok_arr = res
+            return bodies, ids_arr.tolist(), ok_arr.tolist()
+        try:
+            bodies_ba, ids, ok = distill.expand_py(frame, self.directory.get)
+        except distill.DistillError:
+            return None
+        return bytes(bodies_ba), ids, ok
+
+    async def SendDistilledBatch(self, request, context):
+        """Broker-built distilled batch (proto/distill.py wire format).
+
+        Unlike `_admit`'s all-or-nothing contract, admission here is
+        PER-ENTRY: one frame aggregates many mutually-independent
+        clients, so a bad signature drops alone — charged to its OWN
+        client-id's token bucket, never the broker's — and cannot censor
+        co-batched traffic. The broker's identity stays entirely outside
+        the trust boundary: it can withhold or replay, but every entry
+        it forwards is still client-signed over canonical bytes.
+        ACK means "accepted what survived", never a commit receipt."""
+        if self._closing:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, "node shutting down"
+            )
+        expanded = self._expand_distilled(bytes(request.frame))
+        if expanded is None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "malformed distilled frame"
+            )
+        bodies, ids, ok = expanded
+        self.distill_stats["distilled_batches_rx"] += 1
+        misses = len(ok) - sum(ok)
+        if misses:
+            self.distill_stats["directory_misses"] += misses
+        now = self.clock.monotonic()
+        seen = self._distill_seen
+        E = distill.ENTRY_WIRE
+        ad = self.config.admission
+        preverify = ad.preverify and self.verifier is not None
+        kept: List[int] = []
+        keys: List[Tuple[int, int]] = []
+        for i, cid in enumerate(ids):
+            if not ok[i]:
+                continue
+            base = i * E
+            k = (cid, int.from_bytes(bodies[base + 32 : base + 36], "little"))
+            if k in seen:
+                self.distill_stats["dedup_drops"] += 1
+                continue
+            if preverify:
+                bucket = self._admission_refill(f"cid:{cid}", now)
+                if bucket[0] < 1.0:
+                    self.admission_stats["admission_throttled"] += 1
+                    continue
+            kept.append(i)
+            keys.append(k)
+        if preverify and kept:
+            results = await self.verifier.verify_many(
+                [
+                    (
+                        bodies[i * E : i * E + 32],
+                        bodies[i * E + 36 : i * E + 76],
+                        bodies[i * E + 76 : i * E + 140],
+                    )
+                    for i in kept
+                ]
+            )
+            good, good_keys, n_bad = [], [], 0
+            for i, k, okv in zip(kept, keys, results):
+                if okv:
+                    good.append(i)
+                    good_keys.append(k)
+                else:
+                    n_bad += 1
+                    bucket = self._admission_refill(f"cid:{k[0]}", now)
+                    bucket[0] = max(0.0, bucket[0] - 1.0)
+            if n_bad:
+                self.admission_stats["rejected_at_ingress"] += n_bad
+                self.recorder.record("distill_reject", (n_bad, len(kept)))
+            kept, keys = good, good_keys
+        if kept:
+            # mark seen only for entries actually ingested: a client whose
+            # signature failed (or who was throttled) may legitimately
+            # resubmit the same (id, seq) corrected later
+            for k in keys:
+                if len(seen) >= DISTILL_SEEN_CAP:
+                    seen.pop(next(iter(seen)))
+                seen[k] = None
+            await self._ingest_distilled(bodies, kept)
+        return pb.SendAssetReply()
+
+    async def _ingest_distilled(self, bodies: bytes, kept: List[int]) -> None:
+        """Ingress tail for surviving distilled entries. The expanded
+        bodies already ARE the batched plane's ``entries_raw`` layout, so
+        the hot path slices them straight into TxBatch slots — decoding
+        per-entry Payload objects here would reintroduce exactly the
+        per-entry Python cost the distilled format exists to avoid."""
+        E = distill.ENTRY_WIRE
+        bcfg = self.config.batching
+        if not bcfg.enabled or self._closing:
+            # the slow path mirrors _ingest's semantics exactly (sim
+            # configs disable batching; shutdown must not spawn timers)
+            payloads = [
+                Payload.decode_body(bodies[i * E : (i + 1) * E]) for i in kept
+            ]
+            await self.recent.put_many(
+                [(p.sender, p.sequence, p.transaction) for p in payloads]
+            )
+            for p in payloads:
+                await self.broadcast.broadcast(p)
+            return
+        if self.tx_trace.enabled:
+            now = self.clock.monotonic()
+            for i in kept:
+                base = i * E
+                key = (
+                    bodies[base : base + 32],
+                    int.from_bytes(bodies[base + 32 : base + 36], "little"),
+                )
+                self.tx_trace.begin(key, now)
+                self.tx_trace.stamp(key, "admitted", now)
+        # the recent ring holds 10 entries (ledger/recent.py): feeding it
+        # the batch tail leaves observably identical ring state without
+        # per-entry decode of the whole frame
+        tail = [
+            Payload.decode_body(bodies[i * E : (i + 1) * E])
+            for i in kept[-10:]
+        ]
+        await self.recent.put_many(
+            [(p.sender, p.sequence, p.transaction) for p in tail]
+        )
+        if len(kept) * E == len(bodies):
+            entries_raw = bodies  # nothing dropped: zero-copy
+        else:
+            entries_raw = b"".join(
+                bodies[i * E : (i + 1) * E] for i in kept
+            )
+        limit = bcfg.max_entries * E
+        for lo in range(0, len(entries_raw), limit):
+            self._batch_seq += 1
+            batch = TxBatch.create(
+                self.config.sign_key, self._batch_seq, entries_raw[lo : lo + limit]
+            )
+            await self.broadcast.broadcast_batch(batch)

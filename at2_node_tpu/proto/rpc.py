@@ -27,6 +27,10 @@ _METHODS = {
         pb.GetLatestTransactionsRequest,
         pb.GetLatestTransactionsReply,
     ),
+    # Broker ingress tier (ISSUE 7): directory registration + distilled
+    # batch submission (proto/distill.py wire format inside `frame`).
+    "Register": (pb.RegisterRequest, pb.RegisterReply),
+    "SendDistilledBatch": (pb.SendDistilledBatchRequest, pb.SendAssetReply),
 }
 
 
@@ -46,6 +50,12 @@ class At2Servicer:
         raise NotImplementedError
 
     async def GetLatestTransactions(self, request, context):
+        raise NotImplementedError
+
+    async def Register(self, request, context):
+        raise NotImplementedError
+
+    async def SendDistilledBatch(self, request, context):
         raise NotImplementedError
 
 

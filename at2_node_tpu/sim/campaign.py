@@ -22,10 +22,13 @@ import hashlib
 import random
 import time
 from dataclasses import dataclass
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
+from ..crypto.keys import verify_one
+from ..proto import distill
+from ..types import ThinTransaction
 from .fabric import LinkModel
-from .hostile import HostileFrameGen
+from .hostile import HostileFrameGen, mutate_distilled_frame
 from .net import SimNet, sim_client
 
 # An event is [t, kind, args-dict] — JSON-shaped on purpose (banked by
@@ -138,6 +141,96 @@ def generate_events(
     return events
 
 
+BROKER_MUTATIONS = ("none", "dup", "reorder", "garbage", "withhold")
+
+
+def generate_broker_events(
+    rng: random.Random,
+    *,
+    nodes: int = 4,
+    n_clients: int = 4,
+    n_events: int = 30,
+    duration: float = 20.0,
+    hostile: bool = True,
+    faults: bool = True,
+) -> List[Event]:
+    """A byzantine-broker schedule: every client registers into the
+    directory early, then distilled-batch submissions arrive with the
+    broker misbehaving per frame — duplicating, reordering, corrupting
+    ("garbage"), or withholding entries. None of these may cost safety:
+    entries stay client-signed, so a bad broker is a lossy wire, not a
+    forger. Partitions and hostile salvos (which now include
+    DirectoryAnnounce poisoning) interleave as in ``generate_events``."""
+    events: List[Event] = []
+    # registration window [0, 0.5): ids exist before the first frame
+    for c in range(n_clients):
+        events.append(
+            [
+                round(rng.uniform(0.0, 0.5), 3),
+                "breg",
+                {"node": rng.randrange(nodes), "client": c},
+            ]
+        )
+    next_seq = [1] * n_clients
+    for _ in range(n_events):
+        t = round(rng.uniform(1.0, duration), 3)
+        roll = rng.random()
+        if roll < 0.70 or not (hostile or faults):
+            rows = []
+            for _ in range(rng.randint(1, 8)):
+                c = rng.randrange(n_clients)
+                rows.append(
+                    [
+                        c,
+                        next_seq[c],
+                        rng.randrange(n_clients),
+                        rng.randint(1, 50),
+                    ]
+                )
+                next_seq[c] += 1
+            mutation = (
+                "none"
+                if rng.random() < 0.5
+                else rng.choice(BROKER_MUTATIONS[1:])
+            )
+            events.append(
+                [
+                    t,
+                    "bsub",
+                    {
+                        "node": rng.randrange(nodes),
+                        "mutation": mutation,
+                        "salt": rng.getrandbits(32),
+                        "entries": rows,
+                    },
+                ]
+            )
+        elif roll < 0.85 and hostile:
+            events.append(
+                [
+                    t,
+                    "hostile",
+                    {
+                        "targets": sorted(
+                            rng.sample(range(nodes), rng.randint(1, nodes))
+                        ),
+                        "count": rng.randint(1, 6),
+                    },
+                ]
+            )
+        elif faults and nodes >= 2:
+            a, b = rng.sample(range(nodes), 2)
+            events.append(
+                [
+                    t,
+                    "cut",
+                    {"a": a, "b": b, "duration": round(rng.uniform(0.5, 6.0), 3)},
+                ]
+            )
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
 @dataclass
 class EpisodeResult:
     seed: int
@@ -239,6 +332,77 @@ def apply_events(
         net.fabric._tasks.add(task)
         task.add_done_callback(net.fabric._tasks.discard)
 
+    # client index -> directory id, filled by "breg" events (first
+    # successful registration wins; later "bsub" events read it)
+    directory_ids: Dict[int, int] = {}
+
+    def breg(args):
+        async def _reg():
+            cid = await net.aregister(
+                args["node"], clients[args["client"]].public
+            )
+            if cid is not None:
+                directory_ids.setdefault(args["client"], cid)
+
+        task = loop.create_task(_reg())
+        net.fabric._tasks.add(task)
+        task.add_done_callback(net.fabric._tasks.discard)
+
+    def bsub(args):
+        """One broker flush, possibly byzantine. The mutation happens
+        AFTER the clients signed their entries — exactly a corrupting
+        collector's position: it can drop, repeat, split, or mangle
+        frames, but every entry it forwards is client-signed."""
+        rng = random.Random(args["salt"])
+        entries = []
+        for c_i, seq, to_i, amount in args["entries"]:
+            cid = directory_ids.get(c_i)
+            if cid is None:
+                continue  # registration never landed: liveness-only loss
+            to = clients[to_i].public
+            tx = ThinTransaction(to, amount)
+            entries.append(
+                distill.DistilledEntry(
+                    sender_id=cid,
+                    sequence=seq,
+                    recipient=to,
+                    amount=amount,
+                    signature=clients[c_i].sign(tx.signing_bytes()),
+                )
+            )
+            net.touched.add(clients[c_i].public)
+            net.touched.add(to)
+        mutation = args["mutation"]
+        if mutation == "withhold" and len(entries) > 1:
+            # censor a random proper subset: gaps park at the sequence
+            # gate and time out, they never commit out of order
+            keep = sorted(
+                rng.sample(range(len(entries)), rng.randint(1, len(entries) - 1))
+            )
+            entries = [entries[i] for i in keep]
+        if not entries:
+            return
+        if mutation == "dup":
+            frame, _ = distill.distill(entries)
+            frames = [frame, frame]
+        elif mutation == "reorder" and len(entries) > 1:
+            cut = rng.randint(1, len(entries) - 1)
+            # later sequences ship first: the gap-fill fixpoint must
+            # hold them until the earlier half lands
+            frames = [
+                distill.distill(half)[0]
+                for half in (entries[cut:], entries[:cut])
+            ]
+        else:
+            frame, _ = distill.distill(entries)
+            if mutation == "garbage":
+                frame = mutate_distilled_frame(frame, rng)
+            frames = [frame]
+        for frame in frames:
+            task = loop.create_task(net.asubmit_distilled(args["node"], frame))
+            net.fabric._tasks.add(task)
+            task.add_done_callback(net.fabric._tasks.discard)
+
     for t, kind, args in events:
         if kind == "tx":
             loop.call_later(
@@ -288,6 +452,10 @@ def apply_events(
                 loop.call_later(args["duration"], net.fabric.heal, a, b)
 
             loop.call_later(t, cut)
+        elif kind == "breg":
+            loop.call_later(t, breg, args)
+        elif kind == "bsub":
+            loop.call_later(t, bsub, args)
         elif kind == "drop":
 
             def drop(args=args):
@@ -313,6 +481,28 @@ def apply_events(
             raise ValueError(f"unknown event kind: {kind}")
 
 
+def _forged_commit_sweep(net: SimNet) -> List[str]:
+    """Broker-campaign extra invariant: every payload any node committed
+    carries a valid client signature over its own signing bytes. A
+    byzantine broker (or any distilled-path bug) that smuggled an
+    unsigned or altered transfer past ingress shows up here — this is
+    the 'broker can censor but never forge' claim, checked at the
+    ledger, not at the door."""
+    violations: List[str] = []
+    for si, s in enumerate(net.services):
+        for sender, last_seq in sorted(s.accounts.frontier_nowait().items()):
+            for p in s.history.get_range(sender, 1, last_seq + 1):
+                if not verify_one(
+                    p.sender, p.transaction.signing_bytes(), p.signature
+                ):
+                    violations.append(
+                        f"forged commit on node {si}: slot "
+                        f"({sender.hex()[:16]}, {p.sequence}) committed "
+                        "with an invalid client signature"
+                    )
+    return violations
+
+
 def run_episode(
     seed: int,
     *,
@@ -329,6 +519,7 @@ def run_episode(
     ready_threshold: Optional[int] = None,
     config_overrides: Optional[dict] = None,
     capture_obs: Optional[bool] = None,
+    broker: bool = False,
 ) -> EpisodeResult:
     """One self-contained episode: fresh SimNet, (generated or given)
     events, run + settle, invariant check, teardown. Pure in
@@ -337,7 +528,12 @@ def run_episode(
     ``capture_obs``: None (default) attaches recorder dumps + the
     stitched timeline exactly when the episode fails invariants; True
     always captures; False never does (minimization re-runs use this —
-    they only need the boolean verdict)."""
+    they only need the boolean verdict).
+
+    ``broker``: generate a byzantine-broker schedule (ingress via
+    distilled frames with broker mutations) instead of the per-tx one,
+    and additionally sweep every committed payload for a valid client
+    signature (:func:`_forged_commit_sweep`)."""
     wall0 = time.monotonic()
     rng = random.Random(_seed_int("episode", seed))
     net = SimNet(
@@ -353,7 +549,8 @@ def run_episode(
     try:
         clients = [sim_client(seed, i) for i in range(n_clients)]
         if events is None:
-            events = generate_events(
+            generate = generate_broker_events if broker else generate_events
+            events = generate(
                 rng,
                 nodes=nodes,
                 n_clients=n_clients,
@@ -375,6 +572,8 @@ def run_episode(
         net.fabric.heal_all()
         virtual = last_t + 1.0 + net.settle(horizon=settle_horizon)
         violations = net.check_invariants()
+        if broker:
+            violations += _forged_commit_sweep(net)
         obs = None
         if capture_obs or (capture_obs is None and violations):
             obs = _capture_obs(net)
@@ -519,12 +718,14 @@ def run_campaign(
     minimize: bool = False,
     link: Optional[LinkModel] = None,
     progress: Optional[Callable[[int, "EpisodeResult"], None]] = None,
+    broker: bool = False,
 ) -> dict:
     """``episodes`` independent seeded episodes; per-episode seeds derive
     from the campaign seed, failures carry their exact replay recipe
     (seed + event list), and the campaign hash — sha256 over the
     episode trace hashes — is the determinism fingerprint CI compares
-    across two same-seed runs."""
+    across two same-seed runs. ``broker=True`` runs the byzantine-broker
+    flavor of every episode (distilled ingress + forged-commit sweep)."""
     camp_rng = random.Random(_seed_int("campaign", seed))
     results: List[EpisodeResult] = []
     for ep in range(episodes):
@@ -537,6 +738,7 @@ def run_campaign(
             n_events=n_events,
             duration=duration,
             link=link,
+            broker=broker,
         )
         if result.violations and minimize:
             result.minimized = minimize_events(
@@ -550,6 +752,7 @@ def run_campaign(
                         events=evs,
                         link=link,
                         capture_obs=False,
+                        broker=broker,
                     ).violations
                 ),
             )
@@ -565,6 +768,7 @@ def run_campaign(
         "nodes": nodes,
         "f": f,
         "hostile": hostile,
+        "broker": broker,
         "campaign_hash": h.hexdigest(),
         "failures": sum(1 for r in results if not r.ok),
         "results": [r.to_dict() for r in results],

@@ -28,6 +28,7 @@ from ..broadcast.messages import (
     Attestation,
     BatchAttestation,
     ContentRequest,
+    DirectoryAnnounce,
     HistoryBatch,
     HistoryIndexRequest,
     HistoryRequest,
@@ -36,6 +37,32 @@ from ..broadcast.messages import (
 )
 from ..crypto.keys import SignKeyPair
 from ..types import ThinTransaction
+
+
+def mutate_distilled_frame(frame: bytes, rng: random.Random) -> bytes:
+    """One hostile mutation of a well-formed distilled-batch frame
+    (proto/distill.py). Used by the codec fuzz tests (differential: the
+    Python and native parsers must agree on every mutant) and by the
+    byzantine-broker campaign's "garbage" mutation. Mutants are not
+    guaranteed malformed — a flip inside the signature block decodes
+    fine and must then fail per-entry verification instead — which is
+    exactly the coverage a corrupting broker needs."""
+    choice = rng.randrange(6)
+    b = bytearray(frame)
+    if choice == 0 and b:  # magic / version stomp
+        b[rng.randrange(min(2, len(b)))] ^= 0xFF
+    elif choice == 1 and len(b) > 1:  # truncation
+        del b[rng.randint(1, len(b) - 1):]
+    elif choice == 2:  # trailing junk (length checks must catch it)
+        b.extend(rng.getrandbits(8) for _ in range(rng.randint(1, 64)))
+    elif choice == 3 and len(b) > 3:  # single bit flip anywhere past magic
+        b[rng.randrange(2, len(b))] ^= 1 << rng.randrange(8)
+    elif choice == 4 and len(b) > 4:  # stomp the count varints
+        b[2] = rng.choice((0x00, 0x7F, 0x80, 0xFF))
+        b[3] = rng.choice((0x00, 0x7F, 0x80, 0xFF))
+    else:  # pure garbage
+        return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 200)))
+    return bytes(b)
 
 
 def _rng_keypair(rng: random.Random) -> SignKeyPair:
@@ -198,11 +225,30 @@ class HostileFrameGen:
             bytes(rng.getrandbits(8) for _ in range(32)),
         )
 
+    def _rand_dir_announce(self):
+        """Directory-poisoning attempts: out-of-stride ids, zero keys,
+        rebinding collisions. All liveness-only by the trust argument
+        (node/directory.py) — the receiver's stride check and
+        first-binding-wins rule drop or defang every one of these."""
+        rng = self.rng
+        entries = tuple(
+            (
+                rng.getrandbits(rng.choice((4, 16, 62))),
+                (
+                    b"\x00" * 32
+                    if rng.random() < 0.2
+                    else bytes(rng.getrandbits(8) for _ in range(32))
+                ),
+            )
+            for _ in range(rng.randint(0, 5))
+        )
+        return DirectoryAnnounce(self.sign.public, entries)
+
     def _malformed(self) -> bytes:
         rng = self.rng
         choice = rng.randrange(4)
         if choice == 0:  # unknown kind
-            return bytes([rng.randint(13, 255)]) + bytes(
+            return bytes([rng.randint(14, 255)]) + bytes(
                 rng.getrandbits(8) for _ in range(rng.randint(0, 64))
             )
         if choice == 1:  # truncated known message
@@ -233,7 +279,9 @@ class HostileFrameGen:
             frame = self._oversized_batch_attestation().encode()
         elif roll < 0.84:
             frame = self._rand_catchup_junk().encode()
-        elif roll < 0.93 and self.sent_log:
+        elif roll < 0.89:
+            frame = self._rand_dir_announce().encode()
+        elif roll < 0.95 and self.sent_log:
             frame = rng.choice(self.sent_log)  # verbatim replay
         else:
             frame = self._malformed()

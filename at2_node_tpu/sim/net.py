@@ -242,6 +242,36 @@ class SimNet:
             self.asubmit(node, client, sequence, recipient, amount, **kw)
         )
 
+    async def aregister(self, node: int, pubkey: bytes) -> Optional[int]:
+        """Register a client pubkey through the real ``Register`` handler
+        (directory assign + DirectoryAnnounce gossip over the fabric).
+        Returns the assigned client-id, or None on rejection."""
+        ctx = _SimContext("sim-register")
+        try:
+            reply = await self.services[node].Register(
+                pb.RegisterRequest(public_key=pubkey), ctx
+            )
+            return int(reply.client_id)
+        except SimRpcError:
+            return None
+
+    async def asubmit_distilled(
+        self, node: int, frame: bytes, *, source: str = "sim-broker"
+    ):
+        """One distilled-batch frame through the real
+        ``SendDistilledBatch`` handler — the byzantine-broker campaign's
+        ingress (a simulated broker is just whoever built ``frame``).
+        Returns None on accept or the ``SimRpcError`` (malformed frames
+        are normal traffic in hostile episodes)."""
+        ctx = _SimContext(source)
+        try:
+            await self.services[node].SendDistilledBatch(
+                pb.SendDistilledBatchRequest(frame=frame), ctx
+            )
+            return None
+        except SimRpcError as exc:
+            return exc
+
     def settle(
         self, horizon: float = 120.0, window: float = 5.0, stable: int = 4
     ) -> float:

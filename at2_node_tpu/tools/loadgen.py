@@ -120,8 +120,25 @@ async def run_load(
     window: int = 8,
     commit_timeout: float = 120.0,
     rpc_batch: int = 1,
+    broker: bool = False,
 ) -> LoadResult:
     keypairs = [SignKeyPair.random() for _ in range(clients)]
+    if broker:
+        # Directory warmup: pre-register every client identity so the
+        # measured window ships distilled frames with resolvable ids,
+        # not Register round-trips. The endpoints serve the same at2.AT2
+        # surface either way (the broker proxies reads through), so only
+        # this warmup differs from direct-node mode.
+        async def _register(uri: str, kp: SignKeyPair) -> None:
+            async with Client(uri) as c:
+                await c.register(kp.public)
+
+        await asyncio.gather(
+            *(
+                _register(rpcs[i % len(rpcs)], kp)
+                for i, kp in enumerate(keypairs)
+            )
+        )
     t0 = time.monotonic()
     sent = await asyncio.gather(
         *(
@@ -159,6 +176,11 @@ def main(argv=None) -> int:
     ap.add_argument("--rpc-batch", type=int, default=1,
                     help="transfers per SendAssetBatch call (1 = unary "
                     "SendAsset, reference-parity surface)")
+    ap.add_argument("--broker", action="store_true",
+                    help="the --rpc endpoints are broker ingress tiers "
+                    "(tools/broker.py): pre-register every client into "
+                    "the directory, then fire the same load — the broker "
+                    "distills it into SendDistilledBatch frames")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -170,6 +192,7 @@ def main(argv=None) -> int:
             window=args.window,
             commit_timeout=args.commit_timeout,
             rpc_batch=args.rpc_batch,
+            broker=args.broker,
         )
     )
     if args.json:

@@ -10,7 +10,7 @@ must reproduce it byte-identically on any host (CI gates on this).
 Usage:
     python -m at2_node_tpu.tools.sim_run --seed 1 --episodes 50
         [--nodes 4] [--faults 1] [--hostile 1] [--events 30]
-        [--minimize] [--trace-out results.json] [--quiet]
+        [--broker] [--minimize] [--trace-out results.json] [--quiet]
 
 Exit status: 0 if every episode's invariants held, 1 if any violated
 (the banked JSON then carries each failure's exact replay recipe —
@@ -62,6 +62,10 @@ def main(argv=None) -> int:
                         help="events per episode (default 30)")
     parser.add_argument("--duration", type=float, default=20.0,
                         help="virtual seconds of event injection (default 20)")
+    parser.add_argument("--broker", action="store_true",
+                        help="byzantine-broker campaign: distilled-frame "
+                        "ingress with broker mutations (dup / reorder / "
+                        "garbage / withhold) plus a forged-commit sweep")
     parser.add_argument("--minimize", action="store_true",
                         help="greedily minimize each failing schedule")
     parser.add_argument("--trace-out", metavar="PATH",
@@ -100,6 +104,7 @@ def main(argv=None) -> int:
         duration=args.duration,
         minimize=args.minimize,
         progress=progress,
+        broker=args.broker,
     )
     campaign["wall_seconds"] = round(time.monotonic() - wall0, 2)
     campaign["argv"] = sys.argv[1:]

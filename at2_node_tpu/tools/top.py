@@ -88,7 +88,7 @@ def render_frame(rows, now: float, prev) -> str:
         f"{'node':<22}{'health':<9}{'tx/s':>8}{'committed':>11}"
         f"{'p50 ms':>9}{'p99 ms':>9}{'dlv p99':>9}{'live tr':>9}"
         f"{'rej':>6}{'vrf occ':>9}{'q-wait p99':>12}"
-        f"{'backlog':>9}{'peers':>7}"
+        f"{'backlog':>9}{'dstl rx/ms/dd':>15}{'peers':>7}"
     )
     lines = [cols, "-" * len(cols)]
     for addr, sz in rows:
@@ -111,6 +111,13 @@ def render_frame(rows, now: float, prev) -> str:
         occ_s = f"{occ:.2f}" if isinstance(occ, float) else "-"
         qw = vstages.get("queue_wait", {}).get("p99_ms")
         qw_s = f"{qw:.2f}" if isinstance(qw, (int, float)) else "-"
+        # broker-ingress tier: distilled batches received / directory
+        # misses / cross-frame dedup drops, one compact cell
+        dstl_s = (
+            f"{_num(stats, 'distilled_batches_rx')}/"
+            f"{_num(stats, 'directory_misses')}/"
+            f"{_num(stats, 'dedup_drops')}"
+        )
         lines.append(
             f"{addr:<22}"
             f"{health.get('status', '?'):<9}"
@@ -124,6 +131,7 @@ def render_frame(rows, now: float, prev) -> str:
             f"{occ_s:>9}"
             f"{qw_s:>12}"
             f"{_num(stats, 'slots_undelivered'):>9}"
+            f"{dstl_s:>15}"
             f"{_num(health, 'peers_connected'):>4}/"
             f"{_num(health, 'peers_configured'):<2}"
         )

@@ -113,6 +113,33 @@ echo "== sim invariant campaign (50 episodes) =="
 # violation; the printed episode seed is the exact replay recipe.
 python -m at2_node_tpu.tools.sim_run --seed 1 --episodes 50 --quiet
 
+echo "== broker roundtrip smoke gate =="
+# Broker ingress tier (ISSUE 7): codec roundtrip + native parity, the
+# distilled ingress path on the sim fabric (commit/dedup/miss), and a
+# real-gRPC broker roundtrip (register + collect + distill + commit +
+# directory gossip). Named explicitly so a marker/collection change can
+# never drop the broker path from CI.
+python -m pytest tests/test_distill.py -q -m "not slow"
+
+echo "== byzantine-broker campaign =="
+# Corrupting-collector campaign (ISSUE 7): distilled-frame ingress with
+# broker mutations (dup / reorder / garbage / withhold) applied AFTER
+# client signing, full AT2 invariant sweep PLUS a forged-commit sweep
+# (every committed slot re-verified against its client signature) per
+# episode. Run twice: the campaign hash must reproduce byte-identically,
+# same contract as the base determinism gate above.
+broker_hash() {
+  python -m at2_node_tpu.tools.sim_run --seed 11 --episodes 5 --broker \
+    --quiet | sed -n 's/.*hash \([0-9a-f]*\).*/\1/p'
+}
+b1="$(broker_hash)"
+b2="$(broker_hash)"
+if [ -z "$b1" ] || [ "$b1" != "$b2" ]; then
+  echo "byzantine-broker gate FAILED: '$b1' != '$b2'" >&2
+  exit 1
+fi
+echo "same-seed broker campaign hash reproduced: $b1"
+
 if [ "$tier" = "all" ]; then
   echo "== native sanitizers (TSAN + ASAN) =="
   # the reference gets race-freedom from Rust; the C++ prep library gets
