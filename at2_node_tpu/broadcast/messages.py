@@ -5,10 +5,13 @@ The reference gets these from its murmur/sieve/contagion crates
 explicit fixed-size binary records so a frame can carry many of them
 back-to-back and batches parse with zero framing overhead:
 
-* ``Payload`` — the gossiped unit: the client-signed transfer plus the
-  sequence number the broadcast layer binds to it (the reference does the
-  same binding via ``sieve::Payload::new(sender, seq, msg, signature)``,
-  `/root/reference/src/bin/server/rpc.rs:277-282`).
+* ``Payload`` — the gossiped unit: one client transfer in its
+  (sender, sequence) slot. The client signature covers the slot itself
+  (types.py ``transfer_signing_bytes``: tag || sender || seq ||
+  recipient || amount) — stronger than the reference, whose sieve layer
+  binds the sequence outside the signature
+  (`/root/reference/src/bin/server/rpc.rs:277-282`); see types.py for
+  why the RPC-fronted design needs the binding inside.
 * ``Attestation`` — an Echo or Ready: a node's signed vote that it saw a
   specific payload content for a given (sender, sequence) slot. Signing
   bytes carry a phase-specific domain tag so an Echo can never be replayed
@@ -24,7 +27,7 @@ import hashlib
 import struct
 from dataclasses import dataclass
 
-from ..types import ThinTransaction
+from ..types import ThinTransaction, transfer_signing_bytes
 
 GOSSIP = 1
 ECHO = 2
@@ -115,11 +118,41 @@ class Payload:
     sender: bytes
     sequence: int
     transaction: ThinTransaction
-    signature: bytes  # client's ed25519 over transaction.signing_bytes()
+    signature: bytes  # client's ed25519 over to_sign() (types.py v2 tag)
 
     @property
     def slot(self) -> tuple:
         return (self.sender, self.sequence)
+
+    def to_sign(self) -> bytes:
+        """The client-signature preimage: the v2 tagged transfer form
+        binding (sender, sequence, recipient, amount) — see types.py."""
+        return transfer_signing_bytes(
+            self.sender,
+            self.sequence,
+            self.transaction.recipient,
+            self.transaction.amount,
+        )
+
+    @classmethod
+    def create(
+        cls, keypair, sequence: int, transaction: ThinTransaction
+    ) -> "Payload":
+        """Build and client-sign a payload (the one construction path
+        clients, benches, and tests share)."""
+        return cls(
+            keypair.public,
+            sequence,
+            transaction,
+            keypair.sign(
+                transfer_signing_bytes(
+                    keypair.public,
+                    sequence,
+                    transaction.recipient,
+                    transaction.amount,
+                )
+            ),
+        )
 
     def encode(self) -> bytes:
         return bytes([GOSSIP]) + _PAYLOAD.pack(

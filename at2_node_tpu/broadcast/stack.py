@@ -878,11 +878,7 @@ class Broadcast:
             if isinstance(msg, Payload):
                 if self._pre_gossip(msg):  # noqa: SIM102 (kept parallel)
                     to_verify.append(
-                        (
-                            msg.sender,
-                            msg.transaction.signing_bytes(),
-                            msg.signature,
-                        )
+                        (msg.sender, msg.to_sign(), msg.signature)
                     )
                     actions.append((GOSSIP, msg, 1))
             elif isinstance(msg, TxBatch):
@@ -892,8 +888,7 @@ class Broadcast:
                     )
                     entries = msg.entries()
                     to_verify.extend(
-                        (e.sender, e.transaction.signing_bytes(), e.signature)
-                        for e in entries
+                        (e.sender, e.to_sign(), e.signature) for e in entries
                     )
                     actions.append((BATCH, msg, 1 + len(entries)))
             elif isinstance(msg, BatchAttestation):

@@ -8,13 +8,17 @@ does, so existing clients point at a broker unmodified — submissions are
 collected, everything else proxies through to the node.
 
 Trust argument (TECHNICAL.md "Directory & broker ingress"): the broker
-is OUTSIDE the trust boundary. Every entry it forwards is still signed
-by its client over the canonical ThinTransaction bytes, and the node
-verifies per entry against the gossiped directory — a byzantine broker
-can withhold, reorder, or duplicate entries (liveness, bounded by the
-node's dedup memory and per-client admission), but can never forge a
-transfer or shift blame for bad signatures onto other clients: admission
-buckets at the node are keyed by CLIENT id, not broker identity.
+is OUTSIDE the trust boundary. Every entry it forwards is signed by its
+client over the v2 tagged transfer form (types.py
+``transfer_signing_bytes``) which binds sender AND sequence — so a
+captured signature is valid for exactly one ledger slot, and the broker
+cannot re-encode it at another sequence to spend again (nor, of course,
+alter recipient or amount). The node verifies per entry against the
+gossiped directory; what remains to a byzantine broker is liveness-only:
+withhold, reorder, or duplicate-within-one-slot (bounded by the node's
+dedup memory and the ledger's per-account sequence gate). It also cannot
+shift blame for bad signatures onto other clients: admission buckets at
+the node are keyed by CLIENT id, not broker identity.
 
 The broker auto-registers unknown sender keys via the node's `Register`
 RPC and compresses recipient keys to directory ids when it knows them,
@@ -254,6 +258,16 @@ class Broker(At2Servicer):
                     amount=req.amount,
                     signature=bytes(req.signature),
                 )
+            )
+        # re-check occupancy AFTER the awaits above: concurrent _collect
+        # calls can each pass the entry check and then interleave at the
+        # Register round-trips, so only a check with no await point
+        # between it and the extend actually enforces PENDING_CAP
+        if len(self._buf) + len(entries) > PENDING_CAP:
+            self.stats["broker_overflow_drops"] += len(entries)
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "broker buffer full; node unreachable or lagging",
             )
         self._buf.extend(entries)
         self.stats["broker_entries_rx"] += len(entries)

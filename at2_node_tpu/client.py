@@ -22,9 +22,9 @@ from .proto import at2_pb2 as pb
 from .proto.rpc import At2Stub
 from .types import (
     FullTransaction,
-    ThinTransaction,
     TransactionState,
     parse_rfc3339,
+    transfer_signing_bytes,
 )
 
 
@@ -59,11 +59,12 @@ class Client:
         amount: int,
     ) -> None:
         """Sign and submit a transfer (`client.rs:70-91`). The signature
-        covers the canonical ThinTransaction bytes; the sequence rides
-        outside the signed struct, bound in by the broadcast layer
-        (reference parity, `client.rs:77-78`, SURVEY.md C13)."""
-        thin = ThinTransaction(recipient, amount)
-        signature = keypair.sign(thin.signing_bytes())
+        covers the v2 tagged transfer form — sender and sequence bound
+        in (types.py ``transfer_signing_bytes``) — so no middleman
+        (broker or ingress node) can re-submit it at another slot."""
+        signature = keypair.sign(
+            transfer_signing_bytes(keypair.public, sequence, recipient, amount)
+        )
         await self._stub.SendAsset(
             pb.SendAssetRequest(
                 sender=keypair.public,
@@ -88,14 +89,17 @@ class Client:
         transparently (one RPC per chunk, in order)."""
         requests = []
         for sequence, recipient, amount in transfers:
-            thin = ThinTransaction(recipient, amount)
             requests.append(
                 pb.SendAssetRequest(
                     sender=keypair.public,
                     sequence=sequence,
                     recipient=recipient,
                     amount=amount,
-                    signature=keypair.sign(thin.signing_bytes()),
+                    signature=keypair.sign(
+                        transfer_signing_bytes(
+                            keypair.public, sequence, recipient, amount
+                        )
+                    ),
                 )
             )
         for lo in range(0, len(requests), _RPC_BATCH_CAP):

@@ -179,17 +179,32 @@ class AdmissionConfig:
     spending any verifier throughput. Honest clients never pay: valid
     entries cost zero tokens. ``preverify = false`` restores the
     previous behavior (admit everything, verification happens inside the
-    broadcast workers)."""
+    broadcast workers).
+
+    ``register_limit`` / ``register_window`` shape a SEPARATE per-source
+    bucket charged one token per NEW directory assignment (Register,
+    node/service.py): unlike a failed signature, a registration grows
+    every node's directory and checkpoint permanently, so even
+    well-formed calls are rate-bounded. The defaults (1024 per 2 s)
+    clear a broker warming up thousands of clients in seconds while
+    keeping a flooder's permanent-growth rate bounded; the hard backstop
+    is the per-stride cap (node/directory.py MAX_CLIENTS_PER_RANK)."""
 
     preverify: bool = True
     fail_limit: int = 64
     fail_window: float = 10.0
+    register_limit: int = 1024
+    register_window: float = 2.0
 
     def __post_init__(self) -> None:
         if self.fail_limit < 1:
             raise ValueError("admission.fail_limit must be >= 1")
         if self.fail_window <= 0:
             raise ValueError("admission.fail_window must be > 0")
+        if self.register_limit < 1:
+            raise ValueError("admission.register_limit must be >= 1")
+        if self.register_window <= 0:
+            raise ValueError("admission.register_window must be > 0")
 
 
 @dataclass

@@ -22,6 +22,23 @@ native distilled-frame parser can resolve every id in one GIL-released
 pass (``at2_distill_parse`` takes the base pointer + row count). An
 all-zero row means "unassigned" — the zero key is not a usable ed25519
 verification key, so the sentinel cannot shadow a real client.
+
+Because the table is dense, the id space must stay bounded even against
+a byzantine mesh peer: ids are u64 on the wire, and without a bound one
+``DirectoryAnnounce`` claiming id ~2^60 (in the announcer's own stride,
+so it passes the stride check) would force an exabyte-scale allocation
+on every correct receiver. Two limits close that:
+
+* ``MAX_CLIENTS_PER_RANK`` — hard cap on the stride multiplier ``k``
+  (``client_id = rank + total * k``), bounding the table at
+  ``total * MAX_CLIENTS_PER_RANK`` rows no matter what arrives;
+* ``APPLY_GAP_SLACK`` — an accepted id may run at most this many
+  registrations ahead of the mappings already installed for its stride.
+  Announces arrive roughly in assignment order (and checkpoint imports
+  are id-sorted), so honest traffic always fits; a forged far-ahead id
+  is refused without allocating. A legitimate mapping dropped for an
+  out-of-order gap is liveness-only and repairs once the gap fills (the
+  assigning node re-announces on client Register retries).
 """
 
 from __future__ import annotations
@@ -31,6 +48,19 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 _ZERO32 = b"\x00" * 32
+
+# Per-stride registration cap: bounds the dense table (and every peer's
+# copy, and the checkpoint) at total * cap rows of 32 bytes. 2^18 rows
+# = 8 MiB per stride — far above any bench or deployment here.
+MAX_CLIENTS_PER_RANK = 1 << 18
+
+# How far beyond a stride's installed-mapping count an applied id may
+# reach (out-of-order gossip tolerance; see module docstring).
+APPLY_GAP_SLACK = 1024
+
+
+class DirectoryFullError(RuntimeError):
+    """This node's stride hit MAX_CLIENTS_PER_RANK; no ids left."""
 
 
 class ClientDirectory:
@@ -43,6 +73,9 @@ class ClientDirectory:
         self._limit = 0  # rows [0, _limit) may be assigned
         self._ids: Dict[bytes, int] = {}
         self._next_k = 0  # next own-stride multiplier
+        # installed mappings per stride rank, the anchor of the
+        # APPLY_GAP_SLACK bound (assign and apply both advance it)
+        self._rank_applied: Dict[int, int] = {}
 
     def __len__(self) -> int:
         return len(self._ids)
@@ -68,20 +101,27 @@ class ClientDirectory:
         existing = self._ids.get(pubkey)
         if existing is not None:
             return existing, False
+        if self._next_k >= MAX_CLIENTS_PER_RANK:
+            raise DirectoryFullError(
+                f"stride {self.rank} is full ({MAX_CLIENTS_PER_RANK} ids)"
+            )
         client_id = self.rank + self.total * self._next_k
         self._next_k += 1
         self._ensure(client_id)
         self._keys[client_id] = np.frombuffer(pubkey, dtype=np.uint8)
         self._ids[pubkey] = client_id
+        self._rank_applied[self.rank] = self._rank_applied.get(self.rank, 0) + 1
         return client_id, True
 
     def apply(self, client_id: int, pubkey: bytes, rank: Optional[int] = None) -> bool:
         """Install a gossiped mapping. Returns False (without mutating)
         when the mapping is rejected: malformed key, id outside the
-        announcing node's stride (``rank`` given), or the id is already
-        bound to a DIFFERENT key (first binding wins — a conflicting
-        re-announce is exactly the liveness-only poisoning the trust
-        argument allows, so it is dropped, not honored)."""
+        announcing node's stride (``rank`` given), id beyond the growth
+        bounds (MAX_CLIENTS_PER_RANK / APPLY_GAP_SLACK — the allocation
+        DoS guard, refused before any array growth), or the id is
+        already bound to a DIFFERENT key (first binding wins — a
+        conflicting re-announce is exactly the liveness-only poisoning
+        the trust argument allows, so it is dropped, not honored)."""
         if len(pubkey) != 32 or pubkey == _ZERO32 or client_id < 0:
             return False
         if rank is not None and client_id % self.total != rank:
@@ -89,11 +129,18 @@ class ClientDirectory:
         current = self.get(client_id)
         if current is not None:
             return current == pubkey
+        r = client_id % self.total
+        k = client_id // self.total
+        if k >= MAX_CLIENTS_PER_RANK:
+            return False
+        if k > self._rank_applied.get(r, 0) + APPLY_GAP_SLACK:
+            return False
         self._ensure(client_id)
         self._keys[client_id] = np.frombuffer(pubkey, dtype=np.uint8)
         self._ids.setdefault(pubkey, client_id)
-        if client_id % self.total == self.rank:
-            self._next_k = max(self._next_k, client_id // self.total + 1)
+        self._rank_applied[r] = self._rank_applied.get(r, 0) + 1
+        if r == self.rank:
+            self._next_k = max(self._next_k, k + 1)
         return True
 
     def get(self, client_id: int) -> Optional[bytes]:

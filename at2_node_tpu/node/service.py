@@ -57,9 +57,14 @@ from ..obs.trace import REJECTED, TxTrace
 from ..proto import at2_pb2 as pb
 from ..proto import distill
 from ..proto.rpc import At2Servicer, add_to_server
-from ..types import ThinTransaction, TransactionState, rfc3339
+from ..types import (
+    TRANSFER_SIG_TAG,
+    ThinTransaction,
+    TransactionState,
+    rfc3339,
+)
 from .config import Config
-from .directory import ClientDirectory
+from .directory import ClientDirectory, DirectoryFullError
 
 logger = logging.getLogger(__name__)
 
@@ -252,6 +257,11 @@ class Service(At2Servicer):
         # buckets charged ONLY for entries that fail pre-verification —
         # source -> [tokens, refill_stamp]
         self._admission_buckets: Dict[str, list] = {}
+        # Register charges its own per-source bucket (config [admission]
+        # register_limit/register_window): registrations grow every
+        # node's directory and checkpoint PERMANENTLY, so unlike the
+        # fail-only signature bucket each new assignment costs a token.
+        self._register_buckets: Dict[str, list] = {}
         self.admission_stats = self.registry.counter_group(
             ("rejected_at_ingress", "admission_throttled")
         )
@@ -1194,10 +1204,7 @@ class Service(At2Servicer):
             if not candidates:
                 return responses, 0
             results = await self.verifier.verify_many(
-                [
-                    (p.sender, p.transaction.signing_bytes(), p.signature)
-                    for p in candidates
-                ]
+                [(p.sender, p.to_sign(), p.signature) for p in candidates]
             )
             now = self.clock.monotonic()
             frontier = self.accounts.frontier_nowait()
@@ -1306,36 +1313,56 @@ class Service(At2Servicer):
 
     # -- ingress admission (config [admission]) --------------------------
 
-    def _admission_refill(self, source: str, now: float) -> list:
-        """The source's token bucket ``[tokens, stamp]``, refilled
-        continuously to ``fail_limit`` over ``fail_window`` seconds."""
-        ad = self.config.admission
-        rate = ad.fail_limit / ad.fail_window
-        bucket = self._admission_buckets.get(source)
+    @staticmethod
+    def _bucket_refill(
+        buckets: Dict[str, list],
+        source: str,
+        now: float,
+        limit: float,
+        window: float,
+    ) -> list:
+        """The source's token bucket ``[tokens, stamp]`` in ``buckets``,
+        refilled continuously to ``limit`` over ``window`` seconds. All
+        buckets in one dict share (limit, window) — the eviction scan
+        below depends on that."""
+        rate = limit / window
+        bucket = buckets.get(source)
         if bucket is None:
-            if len(self._admission_buckets) >= ADMISSION_SOURCES_CAP:
+            if len(buckets) >= ADMISSION_SOURCES_CAP:
                 # evict fully-refilled buckets first (they carry no
                 # throttling state); if every source is actively failing,
                 # drop the oldest — it restarts with a full bucket
                 full = [
                     k
-                    for k, (t, s) in self._admission_buckets.items()
-                    if t + (now - s) * rate >= ad.fail_limit
+                    for k, (t, s) in buckets.items()
+                    if t + (now - s) * rate >= limit
                 ]
                 for k in full:
-                    del self._admission_buckets[k]
-                if len(self._admission_buckets) >= ADMISSION_SOURCES_CAP:
-                    self._admission_buckets.pop(
-                        next(iter(self._admission_buckets))
-                    )
-            bucket = [float(ad.fail_limit), now]
-            self._admission_buckets[source] = bucket
+                    del buckets[k]
+                if len(buckets) >= ADMISSION_SOURCES_CAP:
+                    buckets.pop(next(iter(buckets)))
+            bucket = [float(limit), now]
+            buckets[source] = bucket
         else:
-            bucket[0] = min(
-                float(ad.fail_limit), bucket[0] + (now - bucket[1]) * rate
-            )
+            bucket[0] = min(float(limit), bucket[0] + (now - bucket[1]) * rate)
             bucket[1] = now
         return bucket
+
+    def _admission_refill(self, source: str, now: float) -> list:
+        ad = self.config.admission
+        return self._bucket_refill(
+            self._admission_buckets, source, now, ad.fail_limit, ad.fail_window
+        )
+
+    def _register_refill(self, source: str, now: float) -> list:
+        ad = self.config.admission
+        return self._bucket_refill(
+            self._register_buckets,
+            source,
+            now,
+            ad.register_limit,
+            ad.register_window,
+        )
 
     async def _admit(self, payloads: List[Payload], context) -> None:
         """Pre-verify client signatures at the RPC boundary: ONE
@@ -1365,10 +1392,7 @@ class Service(At2Servicer):
                 "too many invalid signatures from this source; retry later",
             )
         results = await self.verifier.verify_many(
-            [
-                (p.sender, p.transaction.signing_bytes(), p.signature)
-                for p in payloads
-            ]
+            [(p.sender, p.to_sign(), p.signature) for p in payloads]
         )
         bad = [i for i, ok in enumerate(results) if not ok]
         if not bad:
@@ -1477,17 +1501,52 @@ class Service(At2Servicer):
     async def Register(self, request, context):
         """Directory registration (at2.proto): assign — or look up — the
         dense client-id for a pubkey and announce the mapping to peers.
-        The announce goes out on EVERY call, not just first assignment: a
-        client retrying Register doubles as a gossip repair for mappings
-        peers may have missed."""
+
+        A NEW assignment permanently grows every node's directory array,
+        pubkey map, and checkpoint, so it is charged against the source's
+        register token bucket (config [admission] register_limit/
+        register_window) and refused outright once this node's stride is
+        full (node/directory.py MAX_CLIENTS_PER_RANK). Idempotent
+        re-registration of a known key is free.
+
+        The announce goes out on every call whose id falls in THIS
+        node's stride, not just first assignment: a client retrying
+        Register doubles as a gossip repair for mappings peers may have
+        missed. Ids learned via gossip from another node's stride are
+        NOT re-announced — receivers validate announce ids against the
+        announcing peer's stride and would silently drop them; repair
+        for those belongs to their assigning node."""
         key = bytes(request.public_key)
         if len(key) != 32 or key == b"\x00" * 32:
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
                 "public_key must be 32 nonzero bytes",
             )
-        client_id, _created = self.directory.assign(key)
-        if self.mesh is not None and self.mesh.peers:
+        client_id = self.directory.id_of(key)
+        if client_id is None:
+            peer_fn = getattr(context, "peer", None)
+            source = peer_fn() if callable(peer_fn) else "local"
+            bucket = self._register_refill(source, self.clock.monotonic())
+            if bucket[0] < 1.0:
+                self.admission_stats["admission_throttled"] += 1
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    "registration rate exceeded for this source; retry later",
+                )
+            try:
+                client_id, created = self.directory.assign(key)
+            except DirectoryFullError:
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    "client directory full on this node",
+                )
+            if created:
+                bucket[0] = max(0.0, bucket[0] - 1.0)
+        if (
+            self.mesh is not None
+            and self.mesh.peers
+            and client_id % self.directory.total == self.directory.rank
+        ):
             self.mesh.broadcast(
                 DirectoryAnnounce(
                     self.config.sign_key.public, ((client_id, key),)
@@ -1563,11 +1622,15 @@ class Service(At2Servicer):
             kept.append(i)
             keys.append(k)
         if preverify and kept:
+            # the v2 transfer preimage is TAG + the first 76 body bytes
+            # (sender || seq || recipient || amount — types.py), so a
+            # broker re-encoding a captured signature at another sequence
+            # changes the preimage and fails right here
             results = await self.verifier.verify_many(
                 [
                     (
                         bodies[i * E : i * E + 32],
-                        bodies[i * E + 36 : i * E + 76],
+                        TRANSFER_SIG_TAG + bodies[i * E : i * E + 76],
                         bodies[i * E + 76 : i * E + 140],
                     )
                     for i in kept

@@ -30,9 +30,12 @@ encode a duplicated entry inside one frame (cross-frame duplication is
 caught by the node's dedup window, counted as ``dedup_drops``).
 Signatures live in one columnar trailing block so the variable-length
 head parses without touching them; each signature is the client's
-ed25519 over the SAME canonical bytes the per-tx path signs
-(``ThinTransaction.signing_bytes()``), which is what keeps the broker
-untrusted: it can censor or duplicate, never forge.
+ed25519 over the SAME canonical bytes the per-tx path signs — the v2
+tagged transfer form (types.py ``transfer_signing_bytes``), which binds
+sender AND sequence into the preimage. That binding is what keeps the
+broker untrusted: it can censor or reorder, but it cannot re-encode a
+captured signature at a fresh sequence (the preimage changes), so it
+never forges — not even by replay.
 
 This module is the pure-Python reference codec; ``native/at2_ingest.cpp``
 carries the GIL-released bulk parse (`at2_distill_parse`) that the node

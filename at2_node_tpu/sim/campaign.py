@@ -26,7 +26,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..crypto.keys import verify_one
 from ..proto import distill
-from ..types import ThinTransaction
+from ..types import transfer_signing_bytes
 from .fabric import LinkModel
 from .hostile import HostileFrameGen, mutate_distilled_frame
 from .net import SimNet, sim_client
@@ -141,7 +141,7 @@ def generate_events(
     return events
 
 
-BROKER_MUTATIONS = ("none", "dup", "reorder", "garbage", "withhold")
+BROKER_MUTATIONS = ("none", "dup", "reorder", "garbage", "withhold", "reseq")
 
 
 def generate_broker_events(
@@ -157,9 +157,10 @@ def generate_broker_events(
     """A byzantine-broker schedule: every client registers into the
     directory early, then distilled-batch submissions arrive with the
     broker misbehaving per frame — duplicating, reordering, corrupting
-    ("garbage"), or withholding entries. None of these may cost safety:
-    entries stay client-signed, so a bad broker is a lossy wire, not a
-    forger. Partitions and hostile salvos (which now include
+    ("garbage"), withholding entries, or replaying a captured signature
+    at a shifted sequence ("reseq"). None of these may cost safety:
+    entries stay client-signed over sequence-binding preimages, so a
+    bad broker is a lossy wire, not a forger. Partitions and hostile salvos (which now include
     DirectoryAnnounce poisoning) interleave as in ``generate_events``."""
     events: List[Event] = []
     # registration window [0, 0.5): ids exist before the first frame
@@ -360,14 +361,17 @@ def apply_events(
             if cid is None:
                 continue  # registration never landed: liveness-only loss
             to = clients[to_i].public
-            tx = ThinTransaction(to, amount)
             entries.append(
                 distill.DistilledEntry(
                     sender_id=cid,
                     sequence=seq,
                     recipient=to,
                     amount=amount,
-                    signature=clients[c_i].sign(tx.signing_bytes()),
+                    signature=clients[c_i].sign(
+                        transfer_signing_bytes(
+                            clients[c_i].public, seq, to, amount
+                        )
+                    ),
                 )
             )
             net.touched.add(clients[c_i].public)
@@ -382,6 +386,27 @@ def apply_events(
             entries = [entries[i] for i in keep]
         if not entries:
             return
+        if mutation == "reseq":
+            # The replay forgery: re-encode a captured client signature
+            # at the sender's next unused sequence. Under the v2 tagged
+            # preimage (types.transfer_signing_bytes binds sender AND
+            # sequence) the shifted entry's signature no longer
+            # verifies, so ingress drops it; were it ever to commit,
+            # _forged_commit_sweep would flag the episode.
+            target = rng.choice(entries)
+            victim = max(
+                (e for e in entries if e.sender_id == target.sender_id),
+                key=lambda e: e.sequence,
+            )
+            entries.append(
+                distill.DistilledEntry(
+                    sender_id=victim.sender_id,
+                    sequence=victim.sequence + 1,
+                    recipient=victim.recipient,
+                    amount=victim.amount,
+                    signature=victim.signature,
+                )
+            )
         if mutation == "dup":
             frame, _ = distill.distill(entries)
             frames = [frame, frame]
@@ -492,9 +517,7 @@ def _forged_commit_sweep(net: SimNet) -> List[str]:
     for si, s in enumerate(net.services):
         for sender, last_seq in sorted(s.accounts.frontier_nowait().items()):
             for p in s.history.get_range(sender, 1, last_seq + 1):
-                if not verify_one(
-                    p.sender, p.transaction.signing_bytes(), p.signature
-                ):
+                if not verify_one(p.sender, p.to_sign(), p.signature):
                     violations.append(
                         f"forged commit on node {si}: slot "
                         f"({sender.hex()[:16]}, {p.sequence}) committed "
@@ -616,9 +639,7 @@ def planted_breach_episode(
 
     def payload(to_i, amount):
         tx = ThinTransaction(clients[to_i].public, amount)
-        return Payload(
-            clients[0].public, 1, tx, clients[0].sign(tx.signing_bytes())
-        )
+        return Payload.create(clients[0], 1, tx)
 
     def att_frames(chash):
         out = []

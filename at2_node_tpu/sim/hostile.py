@@ -85,11 +85,9 @@ class HostileFrameGen:
 
     def _payload(self, client, seq, recipient, amount, good_sig=True):
         tx = ThinTransaction(recipient, amount)
-        sig = (
-            client.sign(tx.signing_bytes())
-            if good_sig
-            else bytes(self.rng.getrandbits(8) for _ in range(64))
-        )
+        if good_sig:
+            return Payload.create(client, seq, tx)
+        sig = bytes(self.rng.getrandbits(8) for _ in range(64))
         return Payload(client.public, seq, tx, sig)
 
     def _rand_payload(self):

@@ -26,7 +26,7 @@ from ..net.peers import Peer
 from ..node.config import Config
 from ..node.service import Service
 from ..proto import at2_pb2 as pb
-from ..types import ThinTransaction
+from ..types import transfer_signing_bytes
 from .fabric import LinkModel, SimFabric, SimMesh
 from .scheduler import SimClock, SimScheduler
 
@@ -213,9 +213,12 @@ class SimNet:
         (validation + admission + ingress batcher). Returns the
         handler's outcome: ``None`` on accept, the ``SimRpcError`` on
         rejection (rejections are normal traffic in hostile episodes)."""
-        tx = ThinTransaction(recipient, amount)
         sig = (
-            client.sign(tx.signing_bytes())
+            client.sign(
+                transfer_signing_bytes(
+                    client.public, sequence, recipient, amount
+                )
+            )
             if good_sig
             else b"\x5a" * 64
         )

@@ -99,9 +99,7 @@ async def run(
         payloads = []
         for seq in range(1, txs + 1):
             tx = ThinTransaction(recipient, 1)
-            payloads.append(
-                Payload(sender.public, seq, tx, sender.sign(tx.signing_bytes()))
-            )
+            payloads.append(Payload.create(sender, seq, tx))
         batches = []
         if batch >= 1:  # batch=1 measures the batched plane's fixed cost
             node_key = cfgs[0].sign_key

@@ -1,8 +1,9 @@
 """Shared transaction types and canonical wire serialization.
 
 TPU-native re-design of the reference's shared types
-(`/root/reference/src/lib.rs:17-50`): ``ThinTransaction`` (the payload the
-sender signs), ``TransactionState`` and ``FullTransaction`` (what the
+(`/root/reference/src/lib.rs:17-50`): ``ThinTransaction`` (who gets how
+much — signed as part of :func:`transfer_signing_bytes`),
+``TransactionState`` and ``FullTransaction`` (what the
 recent-transactions ring stores).
 
 Canonical byte layout
@@ -17,10 +18,21 @@ on a canonical layout, so we define one explicitly:
 * integers: little-endian fixed width (u32 for sequence numbers mirroring
   ``sieve::Sequence`` = u32 at `/root/reference/src/at2.proto:13`, u64 for
   amounts);
-* the *signed* form of a ``ThinTransaction`` is ``recipient(32) ||
-  amount(8, LE)`` — note that like the reference the sequence number is NOT
-  part of the signed struct (`/root/reference/src/client.rs:77-78`); it is
-  bound to the payload by the broadcast layer.
+* the *signed* form of a transfer is :func:`transfer_signing_bytes`:
+  ``tag || sender(32) || sequence(4, LE) || recipient(32) || amount(8, LE)``.
+
+The signed form is a DELIBERATE divergence from the reference, which signs
+only ``ThinTransaction{recipient, amount}`` and leaves the sequence to be
+bound by the broadcast layer (`client.rs:77-78`, SURVEY.md C13). That
+binding only holds when the signer runs its own broadcast instance; here
+clients submit through an RPC front (a node, or an UNTRUSTED broker —
+see broker.py), so an unbound signature would let any middleman re-submit
+one observed transfer at sequence last+1, last+2, ... and drain the
+sender. Binding ``sender`` and ``sequence`` into the signed bytes (under a
+versioned domain tag, so no other protocol message can collide) makes a
+captured signature valid for exactly one ledger slot: a byzantine broker
+or ingress node can censor, reorder, or duplicate-within-one-slot, but
+never author a transfer the client did not sign.
 """
 
 from __future__ import annotations
@@ -35,6 +47,30 @@ Sequence = int  # u32, mirrors sieve::Sequence (at2.proto:13)
 PUBLIC_KEY_LEN = 32
 SIGNATURE_LEN = 64
 
+# Domain tag of the transfer signature (v2: sender + sequence bound in;
+# v1 — the reference's recipient||amount form — is not accepted anywhere).
+TRANSFER_SIG_TAG = b"at2-node-tpu/transfer/v2"
+
+
+def transfer_signing_bytes(
+    sender: bytes, sequence: int, recipient: bytes, amount: int
+) -> bytes:
+    """Canonical preimage of a client transfer signature.
+
+    ``tag || sender || sequence(LE u32) || recipient || amount(LE u64)``
+    — byte-identical to ``TRANSFER_SIG_TAG`` + the first 76 bytes of the
+    wire payload body (broadcast/messages.py ``_PAYLOAD``), so bulk
+    verifiers can slice the preimage straight out of parsed frames."""
+    if len(sender) != PUBLIC_KEY_LEN or len(recipient) != PUBLIC_KEY_LEN:
+        raise ValueError("sender/recipient must be 32-byte public keys")
+    return (
+        TRANSFER_SIG_TAG
+        + sender
+        + struct.pack("<I", sequence)
+        + recipient
+        + struct.pack("<Q", amount)
+    )
+
 
 class TransactionState(enum.Enum):
     """Processing status of a transaction (`lib.rs:26-33`)."""
@@ -46,7 +82,8 @@ class TransactionState(enum.Enum):
 
 @dataclass(frozen=True)
 class ThinTransaction:
-    """The signed wire payload: who gets how much (`lib.rs:15-24`)."""
+    """Who gets how much (`lib.rs:15-24`); signed together with the
+    sender and sequence (:func:`transfer_signing_bytes`)."""
 
     recipient: bytes  # 32-byte ed25519 public key
     amount: int  # u64
@@ -56,10 +93,6 @@ class ThinTransaction:
             raise ValueError("recipient must be a 32-byte public key")
         if not 0 <= self.amount < 1 << 64:
             raise ValueError("amount must fit in u64")
-
-    def signing_bytes(self) -> bytes:
-        """Canonical byte form the sender signs (`client.rs:77-78`)."""
-        return self.recipient + struct.pack("<Q", self.amount)
 
 
 @dataclass

@@ -123,7 +123,8 @@ python -m pytest tests/test_distill.py -q -m "not slow"
 
 echo "== byzantine-broker campaign =="
 # Corrupting-collector campaign (ISSUE 7): distilled-frame ingress with
-# broker mutations (dup / reorder / garbage / withhold) applied AFTER
+# broker mutations (dup / reorder / garbage / withhold / reseq — the
+# last replays a captured signature at a shifted sequence) applied AFTER
 # client signing, full AT2 invariant sweep PLUS a forged-commit sweep
 # (every committed slot re-verified against its client signature) per
 # episode. Run twice: the campaign hash must reproduce byte-identically,

@@ -46,8 +46,7 @@ FAUCET = 100_000
 
 
 def make_payload(keypair, seq=1, amount=10, recipient=b"r" * 32):
-    thin = ThinTransaction(recipient, amount)
-    return Payload(keypair.public, seq, thin, keypair.sign(thin.signing_bytes()))
+    return Payload.create(keypair, seq, ThinTransaction(recipient, amount))
 
 
 def make_batch(origin_kp, payloads, batch_seq=1):

@@ -28,8 +28,7 @@ from at2_node_tpu.types import ThinTransaction
 
 
 def make_payload(keypair, seq=1, amount=10, recipient=b"r" * 32):
-    thin = ThinTransaction(recipient, amount)
-    return Payload(keypair.public, seq, thin, keypair.sign(thin.signing_bytes()))
+    return Payload.create(keypair, seq, ThinTransaction(recipient, amount))
 
 
 class TestWire:

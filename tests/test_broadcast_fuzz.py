@@ -153,8 +153,7 @@ class _RoutedMesh:
 
 
 def _signed_payload(client, seq, amount=5):
-    thin = ThinTransaction(b"r" * 32, amount)
-    return Payload(client.public, seq, thin, client.sign(thin.signing_bytes()))
+    return Payload.create(client, seq, ThinTransaction(b"r" * 32, amount))
 
 
 def _check_safety(per_node_deliveries, honest_sigs):
@@ -231,8 +230,7 @@ async def test_consistency_under_loss_and_equivocation(seed):
             # equivocation: two validly-signed contents, one slot,
             # submitted at different nodes
             for amount, node in ((111, 0), (222, 2)):
-                thin = ThinTransaction(b"r" * 32, amount)
-                p = Payload(byz.public, 1, thin, byz.sign(thin.signing_bytes()))
+                p = Payload.create(byz, 1, ThinTransaction(b"r" * 32, amount))
                 honest_sigs.setdefault(byz.public, set()).add(p.signature)
                 await net.bcasts[node].broadcast(p)
             await net.run_to_quiescence()
@@ -273,15 +271,13 @@ async def test_batch_plane_consistency_under_loss_and_equivocation(seed):
             # byzantine client: conflicting (byz, 1) entries ride two
             # different honest nodes' batch slots
             for amount, node in ((111, 1), (222, 2)):
-                thin = ThinTransaction(b"r" * 32, amount)
-                p = Payload(byz.public, 1, thin, byz.sign(thin.signing_bytes()))
+                p = Payload.create(byz, 1, ThinTransaction(b"r" * 32, amount))
                 honest_sigs.setdefault(byz.public, set()).add(p.signature)
                 await net.bcasts[node].broadcast_batch(
                     TxBatch.create(net.keys[node], 7, p.encode()[1:])
                 )
             # ...and a third conflicting content over the per-tx plane
-            thin = ThinTransaction(b"r" * 32, 333)
-            p = Payload(byz.public, 1, thin, byz.sign(thin.signing_bytes()))
+            p = Payload.create(byz, 1, ThinTransaction(b"r" * 32, 333))
             honest_sigs[byz.public].add(p.signature)
             await net.bcasts[3].broadcast(p)
             await net.run_to_quiescence()

@@ -107,14 +107,8 @@ class TestByzantineNode:
             # A to nodes 0-2, B to node 3
             tx_a = ThinTransaction(r1, 10)
             tx_b = ThinTransaction(r2, 99)
-            pay_a = Payload(
-                equivocator.public, 1, tx_a,
-                equivocator.sign(tx_a.signing_bytes()),
-            )
-            pay_b = Payload(
-                equivocator.public, 1, tx_b,
-                equivocator.sign(tx_b.signing_bytes()),
-            )
+            pay_a = Payload.create(equivocator, 1, tx_a)
+            pay_b = Payload.create(equivocator, 1, tx_b)
             for i in range(3):
                 await hostile.send(i, pay_a)
             await hostile.send(3, pay_b)

@@ -31,7 +31,7 @@ _ports = itertools.count(24600)
 def _payload(seed: int, seq: int = 1) -> Payload:
     kp = SignKeyPair.from_hex(f"{seed % 255 + 1:02x}" * 32)
     tx = ThinTransaction(bytes([seed % 256]) * 32, seed + 1)
-    return Payload(kp.public, seq, tx, kp.sign(tx.signing_bytes()))
+    return Payload.create(kp, seq, tx)
 
 
 class _FakeMesh:

@@ -29,7 +29,7 @@ from at2_node_tpu.proto.distill import (
     DistilledEntry,
 )
 from at2_node_tpu.sim.hostile import mutate_distilled_frame
-from at2_node_tpu.types import ThinTransaction
+from at2_node_tpu.types import transfer_signing_bytes
 
 _ports = itertools.count(26600)
 
@@ -283,11 +283,14 @@ class TestDistilledIngress:
     def _frame(self, cid: int, client, rows):
         entries = []
         for seq, recipient, amount in rows:
-            tx = ThinTransaction(recipient, amount)
             entries.append(
                 DistilledEntry(
                     cid, seq, recipient, amount,
-                    client.sign(tx.signing_bytes()),
+                    client.sign(
+                        transfer_signing_bytes(
+                            client.public, seq, recipient, amount
+                        )
+                    ),
                 )
             )
         frame, _ = distill.distill(entries)
@@ -353,11 +356,13 @@ class TestDistilledIngress:
             assert net.services[0].admission_stats["rejected_at_ingress"] >= 1
             # an ALTERED entry (signature from a different body) is the
             # same story: the broker cannot redirect or reprice a transfer
-            tx = ThinTransaction(rcpt, 1)
             altered = distill.distill(
                 [
                     DistilledEntry(
-                        cid, 1, rcpt, 9999, client.sign(tx.signing_bytes())
+                        cid, 1, rcpt, 9999,
+                        client.sign(
+                            transfer_signing_bytes(client.public, 1, rcpt, 1)
+                        ),
                     )
                 ]
             )[0]
@@ -471,3 +476,195 @@ class TestByzantineBrokerCampaign:
                 if kind == "bsub":
                     seen.add(args["mutation"])
         assert seen == set(BROKER_MUTATIONS)
+
+
+class TestReviewHardening:
+    """Regressions for the ingress-tier review findings: signature
+    replay at a shifted sequence, unbounded directory allocation,
+    unthrottled registration, and the broker buffer-cap race."""
+
+    def test_directory_apply_bounds(self):
+        from at2_node_tpu.node.directory import (
+            APPLY_GAP_SLACK,
+            MAX_CLIENTS_PER_RANK,
+        )
+
+        d = ClientDirectory(rank=0, total=2)
+        # an announce naming an astronomical id in the announcer's OWN
+        # stride must be refused BEFORE any allocation: accepting it
+        # would materialize an exabyte-scale dense key array
+        huge = 1 + 2 * (1 << 60)
+        assert d.apply(huge, b"\x11" * 32, rank=1) is False
+        assert len(d) == 0
+        # per-stride hard cap, independent of the gap slack
+        at_cap = 1 + 2 * MAX_CLIENTS_PER_RANK
+        assert d.apply(at_cap, b"\x11" * 32, rank=1) is False
+        # within the slack an id may run ahead of installed count...
+        assert d.apply(1 + 2 * APPLY_GAP_SLACK, b"\x12" * 32, rank=1) is True
+        # ...but one past the (now advanced) slack is refused
+        beyond = 1 + 2 * (APPLY_GAP_SLACK + 1 + APPLY_GAP_SLACK + 1)
+        assert d.apply(beyond, b"\x13" * 32, rank=1) is False
+        # honest in-order announces are unaffected
+        assert d.apply(1, b"\x14" * 32, rank=1) is True
+
+    def test_directory_assign_cap(self, monkeypatch):
+        from at2_node_tpu.node import directory as dir_mod
+
+        monkeypatch.setattr(dir_mod, "MAX_CLIENTS_PER_RANK", 2)
+        d = ClientDirectory(rank=0, total=1)
+        assert d.assign(b"\x01" * 32) == (0, True)
+        assert d.assign(b"\x02" * 32) == (1, True)
+        with pytest.raises(dir_mod.DirectoryFullError):
+            d.assign(b"\x03" * 32)
+        # idempotent lookup of a known key still works at the cap
+        assert d.assign(b"\x01" * 32) == (0, False)
+
+    def test_replay_at_shifted_sequence_rejected(self):
+        """A byzantine broker re-encoding a captured client signature at
+        the sender's next sequence must die at ingress: the v2 preimage
+        (types.transfer_signing_bytes) binds sender and sequence."""
+        from at2_node_tpu.sim.net import SimNet, sim_client
+
+        net = SimNet(4, 1, 903, hostile=0).start()
+        try:
+            run = net.loop.run_until_complete
+            client = sim_client(903, 0)
+            cid = run(net.aregister(0, client.public))
+            assert cid is not None
+            rcpt = sim_client(903, 1).public
+
+            def frame(rows):
+                entries = [
+                    DistilledEntry(
+                        cid, seq, rcpt, amount,
+                        client.sign(
+                            transfer_signing_bytes(
+                                client.public, seq, rcpt, amount
+                            )
+                        ),
+                    )
+                    for seq, amount in rows
+                ]
+                return distill.distill(entries)[0]
+
+            assert run(net.asubmit_distilled(0, frame([(1, 5), (2, 5)]))) is None
+            net.settle(horizon=60.0)
+            for s in net.services:
+                assert run(s.accounts.get_last_sequence(client.public)) == 2
+            # replay seq-2's signature at seq 3, identical recipient and
+            # amount — exactly the repeated-spend re-encoding
+            captured = distill.decode(frame([(2, 5)]))[0]
+            replay = distill.distill(
+                [DistilledEntry(cid, 3, rcpt, 5, captured.signature)]
+            )[0]
+            assert run(net.asubmit_distilled(1, replay)) is None
+            net.settle(horizon=30.0)
+            assert net.services[1].admission_stats["rejected_at_ingress"] >= 1
+            for s in net.services:
+                assert run(s.accounts.get_last_sequence(client.public)) == 2
+            # the slot is not burned: the client's own seq-3 commits
+            assert run(net.asubmit_distilled(0, frame([(3, 7)]))) is None
+            net.settle(horizon=60.0)
+            for s in net.services:
+                assert run(s.accounts.get_last_sequence(client.public)) == 3
+            net.touched.update((client.public, rcpt))
+            assert net.check_invariants() == []
+        finally:
+            net.close()
+
+    def test_register_throttle_and_stride_gated_announce(self):
+        from at2_node_tpu.broadcast.messages import DIR_ANNOUNCE
+        from at2_node_tpu.sim.net import SimNet, sim_client
+
+        net = SimNet(2, 0, 904, hostile=0).start()
+        try:
+            run = net.loop.run_until_complete
+            svc0 = net.services[0]
+            # throttle: new assignments charge the per-source register
+            # bucket; re-registration of a known key stays free
+            svc0.config.admission.register_limit = 2
+            svc0.config.admission.register_window = 10_000.0
+            k1, k2, k3 = (sim_client(904, i).public for i in range(3))
+            cid1 = run(net.aregister(0, k1))
+            assert cid1 is not None
+            assert run(net.aregister(0, k2)) is not None
+            assert run(net.aregister(0, k3)) is None  # bucket drained
+            assert svc0.admission_stats["admission_throttled"] >= 1
+            assert run(net.aregister(0, k1)) == cid1  # lookup: free
+            # stride gate: node 1 learned (cid1 -> k1) via gossip; a
+            # Register for the same key on node 1 must return the id
+            # WITHOUT re-announcing it under node 1's origin (receivers
+            # would drop the out-of-stride announce anyway)
+            net.settle(horizon=30.0)
+            assert net.services[1].directory.get(cid1) == k1
+            sent = []
+            mesh1 = net.services[1].mesh
+            orig = mesh1.broadcast
+
+            def spy(frame, *a, **kw):
+                sent.append(bytes(frame))
+                return orig(frame, *a, **kw)
+
+            mesh1.broadcast = spy
+            assert run(net.aregister(1, k1)) == cid1
+            assert not any(f and f[0] == DIR_ANNOUNCE for f in sent)
+            # a genuinely new key on node 1 still announces its own id
+            k4 = sim_client(904, 9).public
+            assert run(net.aregister(1, k4)) is not None
+            assert any(f and f[0] == DIR_ANNOUNCE for f in sent)
+        finally:
+            net.close()
+
+    @pytest.mark.asyncio
+    async def test_broker_collect_recheck_after_awaits(self, monkeypatch):
+        """Two _collect calls interleaving at the Register await must
+        not overshoot PENDING_CAP: the capacity check re-runs with no
+        await point before the buffer extend."""
+        from at2_node_tpu import broker as broker_mod
+        from at2_node_tpu.proto import at2_pb2 as pb
+
+        monkeypatch.setattr(broker_mod, "PENDING_CAP", 3)
+        br = broker_mod.Broker("http://127.0.0.1:1", window=60.0)
+        gate = asyncio.Event()
+
+        async def slow_client_id(pubkey):
+            await gate.wait()
+            return 1
+
+        br._client_id = slow_client_id
+
+        class Ctx:
+            def peer(self):
+                return "test"
+
+            async def abort(self, code, details=""):
+                raise RuntimeError(f"abort {code}: {details}")
+
+        kp = SignKeyPair.random()
+
+        def reqs(base):
+            return [
+                pb.SendAssetRequest(
+                    sender=kp.public, sequence=base + i,
+                    recipient=kp.public, amount=1, signature=b"\x01" * 64,
+                )
+                for i in range(2)
+            ]
+
+        try:
+            tasks = [
+                asyncio.ensure_future(br._collect(reqs(b), Ctx()))
+                for b in (1, 10)
+            ]
+            await asyncio.sleep(0)  # both pass the pre-check, both stall
+            gate.set()
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            aborted = [r for r in results if isinstance(r, RuntimeError)]
+            assert len(aborted) == 1, results
+            assert len(br._buf) == 2  # never overshot the cap of 3
+            assert br.stats["broker_overflow_drops"] == 2
+        finally:
+            br._buf.clear()
+            if br._flush_task is not None:
+                br._flush_task.cancel()
+            await br.close()

@@ -670,12 +670,7 @@ class TestPoisonChaos:
                     thin = ThinTransaction(recipient, 1)
                     await self._submit(
                         services[0],
-                        Payload(
-                            sender.public,
-                            seq,
-                            thin,
-                            sender.sign(thin.signing_bytes()),
-                        ),
+                        Payload.create(sender, seq, thin),
                     )
                 if poison:
                     # fresh forged sender each round: a bad-sig entry in

@@ -212,19 +212,32 @@ def test_ring_caps_at_ten_and_update_missing_is_nop():
 
 
 def test_signing_bytes_layout():
+    from at2_node_tpu.types import TRANSFER_SIG_TAG, transfer_signing_bytes
+
+    sender = bytes(range(32, 64))
     recipient = bytes(range(32))
-    thin = ThinTransaction(recipient=recipient, amount=5)
-    assert thin.signing_bytes() == recipient + (5).to_bytes(8, "little")
+    assert transfer_signing_bytes(sender, 3, recipient, 5) == (
+        TRANSFER_SIG_TAG
+        + sender
+        + (3).to_bytes(4, "little")
+        + recipient
+        + (5).to_bytes(8, "little")
+    )
 
 
 def test_sign_verify_roundtrip():
     from at2_node_tpu.crypto.keys import verify_one
+    from at2_node_tpu.types import transfer_signing_bytes
 
     keypair = SignKeyPair.random()
-    thin = ThinTransaction(recipient=SignKeyPair.random().public, amount=42)
-    sig = keypair.sign(thin.signing_bytes())
-    assert verify_one(keypair.public, thin.signing_bytes(), sig)
+    recipient = SignKeyPair.random().public
+    msg = transfer_signing_bytes(keypair.public, 1, recipient, 42)
+    sig = keypair.sign(msg)
+    assert verify_one(keypair.public, msg, sig)
     assert not verify_one(keypair.public, b"other message", sig)
+    # sequence is bound: the same signature fails at a shifted slot
+    shifted = transfer_signing_bytes(keypair.public, 2, recipient, 42)
+    assert not verify_one(keypair.public, shifted, sig)
 
 
 # -- bulk ring/ledger operations (round 5: one lock round-trip per batch) --

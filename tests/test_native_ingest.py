@@ -37,7 +37,7 @@ pytestmark = pytest.mark.skipif(
 def _rand_payload(rng: random.Random) -> Payload:
     kp = SignKeyPair.from_hex(f"{rng.randrange(1, 255):02x}" * 32)
     tx = ThinTransaction(rng.randbytes(32), rng.randrange(1 << 64))
-    return Payload(kp.public, rng.randrange(1 << 32), tx, kp.sign(tx.signing_bytes()))
+    return Payload.create(kp, rng.randrange(1 << 32), tx)
 
 
 def _rand_attestation(rng: random.Random) -> Attestation:

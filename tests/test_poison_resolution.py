@@ -38,7 +38,7 @@ from at2_node_tpu.crypto.verifier import make_verifier
 from at2_node_tpu.node.config import AdmissionConfig
 from at2_node_tpu.node.service import Service
 from at2_node_tpu.proto import at2_pb2 as pb
-from at2_node_tpu.types import ThinTransaction
+from at2_node_tpu.types import ThinTransaction, transfer_signing_bytes
 
 from conftest import make_net_configs, wait_until
 
@@ -48,8 +48,7 @@ FAUCET = 100_000
 
 
 def make_payload(keypair, seq=1, amount=10, recipient=b"r" * 32):
-    thin = ThinTransaction(recipient, amount)
-    return Payload(keypair.public, seq, thin, keypair.sign(thin.signing_bytes()))
+    return Payload.create(keypair, seq, ThinTransaction(recipient, amount))
 
 
 def bad_payload(public, seq=1, amount=10, recipient=b"r" * 32):
@@ -489,11 +488,14 @@ class TestAdmission:
             sender = SignKeyPair.random()
             reqs = []
             for i, seq in enumerate((1, 2, 3)):
-                thin = ThinTransaction(b"r" * 32, 10)
                 sig = (
                     b"\x02" * 64
                     if i == 1
-                    else sender.sign(thin.signing_bytes())
+                    else sender.sign(
+                        transfer_signing_bytes(
+                            sender.public, seq, b"r" * 32, 10
+                        )
+                    )
                 )
                 reqs.append(
                     pb.SendAssetRequest(

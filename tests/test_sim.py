@@ -291,9 +291,7 @@ class TestScenarios:
 
         def payload(to_i, amount):
             tx = ThinTransaction(clients[to_i].public, amount)
-            return Payload(
-                clients[0].public, 1, tx, clients[0].sign(tx.signing_bytes())
-            )
+            return Payload.create(clients[0], 1, tx)
 
         def att_frames(chash):
             out = []

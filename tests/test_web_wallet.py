@@ -20,9 +20,9 @@ Also pinned here (pre-existing):
   WebCrypto is byte-identical to the real PKCS8 encoding `cryptography`
   produces — the single most fragile constant on the page (a wrong
   prefix silently derives a different key);
-* the signed byte layout the page builds (recipient || amount LE, no
-  sequence) matches types.ThinTransaction.signing_bytes, so a browser
-  signature verifies server-side;
+* the signed byte layout the page builds (tag || sender || sequence LE
+  || recipient || amount LE) matches types.transfer_signing_bytes, so a
+  browser signature verifies server-side;
 * the page references the correct service path and content type.
 """
 
@@ -43,7 +43,7 @@ from cryptography.hazmat.primitives.asymmetric import ed25519  # noqa: E402
 
 from at2_node_tpu.crypto.keys import SignKeyPair
 from at2_node_tpu.proto import at2_pb2 as pb
-from at2_node_tpu.types import ThinTransaction
+from at2_node_tpu.types import TRANSFER_SIG_TAG, transfer_signing_bytes
 
 PAGE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -77,18 +77,30 @@ def test_pkcs8_prefix_matches_real_encoding():
 
 def test_signing_layout_matches_canonical():
     page = _page()
-    # the page signs concat(recipient, amountLe) with LE u64 — the same
-    # canonical form ThinTransaction.signing_bytes defines
+    # the page signs tag || sender || seqLe || recipient || amountLe with
+    # LE u32/u64 — the same canonical v2 form transfer_signing_bytes
+    # defines, sequence fetched BEFORE signing so it lands in the preimage
+    assert 'TextEncoder().encode("at2-node-tpu/transfer/v2")' in page
+    assert "setUint32(0, Number(sequence), true)" in page  # little-endian
     assert "setBigUint64(0, amount, true)" in page  # little-endian
-    assert "concat(recipient, amountLe)" in page
-    thin = ThinTransaction(b"\x07" * 32, 513)
-    assert thin.signing_bytes() == b"\x07" * 32 + (513).to_bytes(8, "little")
-    # a signature over that layout verifies with the repo's own keys
+    assert (
+        "concat(\n    TRANSFER_SIG_TAG, keyPair.publicKey, seqLe, "
+        "recipient, amountLe)" in page
+    )
     kp = SignKeyPair.from_hex("2b" * 32)
-    sig = kp.sign(thin.signing_bytes())
+    pre = transfer_signing_bytes(kp.public, 513, b"\x07" * 32, 9)
+    assert pre == (
+        TRANSFER_SIG_TAG
+        + kp.public
+        + (513).to_bytes(4, "little")
+        + b"\x07" * 32
+        + (9).to_bytes(8, "little")
+    )
+    # a signature over that layout verifies with the repo's own keys
+    sig = kp.sign(pre)
     from at2_node_tpu.crypto.keys import verify_one
 
-    assert verify_one(kp.public, thin.signing_bytes(), sig)
+    assert verify_one(kp.public, pre, sig)
 
 
 def _expected_golden() -> dict:

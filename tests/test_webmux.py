@@ -18,7 +18,7 @@ from at2_node_tpu.net.webmux import _DATA_FRAME, _TRAILER_FRAME, _frame, _parse_
 from at2_node_tpu.node.config import Config
 from at2_node_tpu.node.service import Service
 from at2_node_tpu.proto import at2_pb2 as pb
-from at2_node_tpu.types import ThinTransaction
+from at2_node_tpu.types import transfer_signing_bytes
 
 _ports = itertools.count(25100)
 
@@ -102,13 +102,16 @@ class TestGrpcWeb:
         service = await Service.start(cfg)
         try:
             sender, recipient = SignKeyPair.random(), SignKeyPair.random()
-            thin = ThinTransaction(recipient.public, 77)
             request = pb.SendAssetRequest(
                 sender=sender.public,
                 sequence=1,
                 recipient=recipient.public,
                 amount=77,
-                signature=sender.sign(thin.signing_bytes()),
+                signature=sender.sign(
+                    transfer_signing_bytes(
+                        sender.public, 1, recipient.public, 77
+                    )
+                ),
             )
             status, reply = await _grpc_web_call(
                 cfg.rpc_address, "SendAsset", request
