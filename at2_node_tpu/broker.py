@@ -37,7 +37,9 @@ import grpc
 from .client import _target
 from .crypto.keys import SignKeyPair  # noqa: F401  (re-export for runners)
 from .net.webmux import PortMux
+from .obs.recorder import FlightRecorder
 from .obs.registry import Registry
+from .obs.trace import TxTrace
 from .proto import at2_pb2 as pb
 from .proto import distill
 from .proto.rpc import At2Servicer, At2Stub, add_to_server
@@ -48,6 +50,12 @@ logger = logging.getLogger(__name__)
 # Beyond the cap new submissions are refused (RESOURCE_EXHAUSTED) — an
 # unbounded buffer would turn a dead node into broker OOM.
 PENDING_CAP = 1 << 16
+
+# /healthz flips to "degraded" when the pending buffer crosses this
+# fraction of PENDING_CAP: overflow refusals are imminent, so fleet
+# tooling (top.py --once) should gate BEFORE clients start seeing
+# RESOURCE_EXHAUSTED, not after.
+BACKPRESSURE_FRAC = 0.75
 
 
 class Broker(At2Servicer):
@@ -60,6 +68,8 @@ class Broker(At2Servicer):
         max_entries: int = distill.DISTILL_MAX_ENTRIES,
         window: float = 0.005,
         clock=None,
+        trace_sample: int = 1,
+        recorder_cap: int = 2048,
     ) -> None:
         from .clock import SYSTEM_CLOCK
 
@@ -74,12 +84,14 @@ class Broker(At2Servicer):
         self._channel = grpc.aio.insecure_channel(_target(node_uri))
         self._stub = At2Stub(self._channel)
         self._ids: Dict[bytes, int] = {}  # pubkey -> directory client-id
+        self._keys: Dict[int, bytes] = {}  # directory client-id -> pubkey
         self._buf: List[distill.DistilledEntry] = []
         self._flush_task: Optional[asyncio.Task] = None
         self._closing = False
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._mux: Optional[PortMux] = None
         self._started_at = self.clock.monotonic()
+        self._health_was_ok = True
 
         self.registry = Registry()
         self.stats = self.registry.counter_group(
@@ -109,6 +121,28 @@ class Broker(At2Servicer):
         self.registry.register_provider(
             "rpc_",
             lambda: self._mux.stats() if self._mux is not None else {},
+        )
+        # Relay-only lifecycle tracer: the broker never calls begin() —
+        # broker_rx/broker_flush stamps open relay spans via the SAME
+        # keyed lottery the nodes use, so trace_collect joins the
+        # client→broker→node→commit timeline fleet-wide. Custody ends at
+        # flush, so records retire there and populate GET /tracez.
+        self.tx_trace = TxTrace(
+            self.registry,
+            sample_every=trace_sample,
+            clock=self.clock,
+            retire_at="broker_flush",
+        )
+        # Black box for the broker's only two interesting decisions:
+        # when it flushed (and how big) and when it pushed back.
+        self.recorder = FlightRecorder(cap=recorder_cap, clock=self.clock)
+        self.registry.gauge(
+            "recorder_events", "flight-recorder events currently in the ring",
+            fn=lambda: self.recorder.recorded,
+        )
+        self.registry.gauge(
+            "recorder_snapshots", "flight-recorder snapshots frozen",
+            fn=lambda: self.recorder.snapshots_taken,
         )
 
     # -- lifecycle --------------------------------------------------------
@@ -180,25 +214,85 @@ class Broker(At2Servicer):
     _OBS_JSON = "application/json; charset=utf-8"
     _OBS_PROM = "text/plain; version=0.0.4; charset=utf-8"
 
+    def health_verdict(self) -> dict:
+        """Liveness + backpressure verdict. A broker has no quorum to
+        watch; what can go wrong is exactly one thing — the pending
+        buffer filling because the node is unreachable or lagging — so
+        "degraded" means overflow refusals are imminent
+        (pending >= BACKPRESSURE_FRAC * PENDING_CAP). Transitions out of
+        "ok" freeze a flight-recorder snapshot, same edge-trigger
+        contract as the node."""
+        pending = len(self._buf)
+        backpressure = pending >= int(PENDING_CAP * BACKPRESSURE_FRAC)
+        if self._closing:
+            status = "closing"
+        elif backpressure:
+            status = "degraded"
+        else:
+            status = "ok"
+        ok = status == "ok"
+        if self._health_was_ok and not ok:
+            self.recorder.snapshot(f"broker_degraded:{status}")
+        self._health_was_ok = ok
+        return {
+            "status": status,
+            "role": "broker",
+            "node": self.node_uri,
+            "pending": pending,
+            "pending_cap": PENDING_CAP,
+            "backpressure": backpressure,
+            "flush_p99_ms": self.h_build.snapshot()["p99_ms"],
+            "uptime_s": round(self.clock.monotonic() - self._started_at, 3),
+        }
+
+    def tracez(self, limit: int | None = None) -> dict:
+        """Broker-side trace dump in the shape trace_collect expects
+        (one dump per party, keyed by a fleet-unique "node" label)."""
+        out = self.tx_trace.tracez(limit)
+        out["node"] = f"broker:{self.node_uri}"
+        out["clock"] = {
+            "monotonic": round(self.clock.monotonic(), 9),
+            "wall": round(self.clock.wall(), 9),
+        }
+        return out
+
     def obs_http(self, path: str):
-        route, _, _query = path.partition("?")
+        route, _, query = path.partition("?")
         if route == "/metrics":
             return 200, self._OBS_PROM, self.registry.render_prometheus().encode()
         if route == "/healthz":
-            verdict = {
-                "status": "closing" if self._closing else "ok",
-                "role": "broker",
-                "node": self.node_uri,
-                "pending": len(self._buf),
-                "uptime_s": round(
-                    self.clock.monotonic() - self._started_at, 3
-                ),
-            }
-            status = 200 if not self._closing else 503
+            verdict = self.health_verdict()
+            status = 200 if verdict["status"] == "ok" else 503
             return status, self._OBS_JSON, json.dumps(verdict, sort_keys=True).encode()
         if route == "/statusz":
             body = json.dumps(
-                {"role": "broker", "stats": self.registry.snapshot()},
+                {
+                    "role": "broker",
+                    "health": self.health_verdict(),
+                    "flush": self.h_build.snapshot(),
+                    "stats": self.registry.snapshot(),
+                },
+                sort_keys=True,
+                default=float,
+            ).encode()
+            return 200, self._OBS_JSON, body
+        if route == "/tracez":
+            limit = None
+            if query.startswith("limit="):
+                try:
+                    limit = int(query[6:])
+                except ValueError:
+                    limit = None
+            body = json.dumps(
+                self.tracez(limit), sort_keys=True, default=float
+            ).encode()
+            return 200, self._OBS_JSON, body
+        if route == "/debugz":
+            body = json.dumps(
+                {
+                    "node": f"broker:{self.node_uri}",
+                    "recorder": self.recorder.dump(),
+                },
                 sort_keys=True,
                 default=float,
             ).encode()
@@ -218,6 +312,7 @@ class Broker(At2Servicer):
             )
             cid = int(reply.client_id)
             self._ids[pubkey] = cid
+            self._keys[cid] = pubkey
             self.stats["broker_registrations"] += 1
         return cid
 
@@ -228,6 +323,9 @@ class Broker(At2Servicer):
             )
         if len(self._buf) + len(requests) > PENDING_CAP:
             self.stats["broker_overflow_drops"] += len(requests)
+            self.recorder.record(
+                "backpressure", (len(requests), len(self._buf))
+            )
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 "broker buffer full; node unreachable or lagging",
@@ -265,12 +363,21 @@ class Broker(At2Servicer):
         # between it and the extend actually enforces PENDING_CAP
         if len(self._buf) + len(entries) > PENDING_CAP:
             self.stats["broker_overflow_drops"] += len(entries)
+            self.recorder.record(
+                "backpressure", (len(entries), len(self._buf))
+            )
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 "broker buffer full; node unreachable or lagging",
             )
         self._buf.extend(entries)
         self.stats["broker_entries_rx"] += len(entries)
+        # the raw request still has the sender pubkey in hand here, so
+        # this is the cheapest place to open the broker-hop relay span
+        for req in requests:
+            self.tx_trace.stamp(
+                (bytes(req.sender), int(req.sequence)), "broker_rx"
+            )
         if len(self._buf) >= self.max_entries:
             await self._flush()
         elif self._flush_task is None or self._flush_task.done():
@@ -289,13 +396,23 @@ class Broker(At2Servicer):
         entries arriving while a forward is awaited wait for their own
         trigger instead of leaking into this flush."""
         buf, self._buf = self._buf, []
+        if buf:
+            self.recorder.record("flush", (len(buf),))
         for lo in range(0, len(buf), self.max_entries):
             chunk = buf[lo : lo + self.max_entries]
             t0 = self.clock.monotonic()
             frame, dropped = distill.distill(chunk)
             self.h_build.observe(self.clock.monotonic() - t0)
+            # DistilledEntry only carries the directory id; the reverse
+            # map recovers the (pubkey, seq) trace key so the flush
+            # stamp joins the span opened at broker_rx
+            for e in chunk:
+                pub = self._keys.get(e.sender_id)
+                if pub is not None:
+                    self.tx_trace.stamp((pub, e.sequence), "broker_flush")
             if dropped:
                 self.stats["broker_dedup_drops"] += dropped
+                self.recorder.record("dedup_drop", (dropped,))
             try:
                 await self._stub.SendDistilledBatch(
                     pb.SendDistilledBatchRequest(frame=frame)
@@ -305,6 +422,9 @@ class Broker(At2Servicer):
                 # its ingress buffer on shutdown: ACK was never a commit
                 # receipt. The counter (and /metrics) carries the loss.
                 self.stats["broker_forward_errors"] += 1
+                self.recorder.record(
+                    "forward_error", (str(exc.code()), len(chunk))
+                )
                 logger.warning(
                     "distilled forward failed (%s): %s",
                     exc.code(),
@@ -334,6 +454,7 @@ class Broker(At2Servicer):
         reply = await self._stub.Register(request)
         if len(request.public_key) == 32:
             self._ids[bytes(request.public_key)] = int(reply.client_id)
+            self._keys[int(reply.client_id)] = bytes(request.public_key)
         return reply
 
     async def SendDistilledBatch(self, request, context):

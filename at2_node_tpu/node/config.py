@@ -23,6 +23,10 @@ Two conscious additions over the reference schema:
   and `trace_sample` / `trace_cap` (tx-lifecycle tracer sampling and
   cardinality bounds, obs/trace.py) —
   SURVEY.md §5's "per-stage counters + jax.profiler from day 1";
+* an optional `[slo]` table — declarative service-level objectives
+  (commit-latency p99 ceiling, throughput floor, rejection-rate ceiling,
+  quorum-stall budget) evaluated with multi-window burn rates and served
+  on GET /sloz (see `SloConfig` and obs/slo.py);
 * an optional `[checkpoint]` table — `path` (ledger snapshot file;
   restored on start when present) and `interval` (seconds between
   snapshots) — implements the reference's open "store state on disk to
@@ -103,6 +107,33 @@ class ObservabilityConfig:
             raise ValueError("observability.trace_done_cap must be >= 1")
         if self.recorder_cap < 0:
             raise ValueError("observability.recorder_cap must be >= 0")
+
+
+@dataclass
+class SloConfig:
+    """Service-level objectives (obs/slo.py): declarative targets
+    evaluated live with multi-window burn rates, served on GET /sloz and
+    folded into /healthz. ``probe_interval`` is the sampling cadence of
+    the background probe (only runs on a real served node; the simulator
+    evaluates cells offline). The default targets are deliberately
+    lenient — they flag a broken node, not a slow one; tighten per
+    deployment. A target <= 0 disables that objective; ``enabled =
+    false`` disables probing and /sloz reports no_data forever."""
+
+    enabled: bool = True
+    fast_window: float = 30.0  # fast burn window, seconds
+    slow_window: float = 300.0  # slow burn window, seconds
+    probe_interval: float = 2.0  # seconds between probe samples
+    latency_p99_ms: float = 2000.0  # ingress→commit p99 ceiling
+    throughput_floor_tps: float = 0.0  # committed tx/s floor; 0 = off
+    rejection_ratio_max: float = 0.95  # rejected/(rej+committed) ceiling
+    stall_budget: float = 0.5  # commit-stalled fraction of window
+
+    def __post_init__(self) -> None:
+        if self.fast_window <= 0 or self.slow_window <= 0:
+            raise ValueError("slo windows must be > 0")
+        if self.probe_interval <= 0:
+            raise ValueError("slo.probe_interval must be > 0")
 
 
 @dataclass
@@ -218,6 +249,7 @@ class Config:
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig
     )
+    slo: SloConfig = field(default_factory=SloConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     catchup: CatchupConfig = field(default_factory=CatchupConfig)
     batching: BatchingConfig = field(default_factory=BatchingConfig)
@@ -260,6 +292,20 @@ class Config:
                 f"trace_cap = {obs.trace_cap}",
                 f"trace_done_cap = {obs.trace_done_cap}",
                 f"recorder_cap = {obs.recorder_cap}",
+            ]
+        slo = self.slo
+        if slo != SloConfig():
+            lines += [
+                "",
+                "[slo]",
+                f"enabled = {'true' if slo.enabled else 'false'}",
+                f"fast_window = {slo.fast_window}",
+                f"slow_window = {slo.slow_window}",
+                f"probe_interval = {slo.probe_interval}",
+                f"latency_p99_ms = {slo.latency_p99_ms}",
+                f"throughput_floor_tps = {slo.throughput_floor_tps}",
+                f"rejection_ratio_max = {slo.rejection_ratio_max}",
+                f"stall_budget = {slo.stall_budget}",
             ]
         if self.checkpoint.path:
             lines += [
@@ -312,6 +358,7 @@ class Config:
         doc = tomllib.loads(text)
         verifier = VerifierConfig(**doc.get("verifier", {}))
         observability = ObservabilityConfig(**doc.get("observability", {}))
+        slo = SloConfig(**doc.get("slo", {}))
         ckpt = CheckpointConfig(**doc.get("checkpoint", {}))
         catchup = CatchupConfig(**doc.get("catchup", {}))
         batching = BatchingConfig(**doc.get("batching", {}))
@@ -331,6 +378,7 @@ class Config:
             ],
             verifier=verifier,
             observability=observability,
+            slo=slo,
             checkpoint=ckpt,
             catchup=catchup,
             batching=batching,

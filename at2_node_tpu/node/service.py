@@ -53,6 +53,7 @@ from ..net.peers import Mesh, Peer
 from ..net.webmux import PortMux
 from ..obs.recorder import FlightRecorder
 from ..obs.registry import Registry
+from ..obs.slo import SloEngine, default_objectives
 from ..obs.trace import REJECTED, TxTrace
 from ..proto import at2_pb2 as pb
 from ..proto import distill
@@ -192,6 +193,35 @@ class Service(At2Servicer):
         )
         self._health_was_ok = True
         self._started_at = self.clock.monotonic()
+        # SLO engine (obs/slo.py): declarative objectives from the [slo]
+        # config table, probed periodically (start() spawns the loop on
+        # served nodes), served at GET /sloz, folded into /healthz.
+        # Constructed unconditionally — snapshot_stats()'s key set must
+        # not depend on traffic or config — the probe task is what the
+        # enabled flag gates.
+        slo_cfg = config.slo
+        self.slo = SloEngine(
+            default_objectives(
+                latency_p99_ms=slo_cfg.latency_p99_ms,
+                throughput_floor_tps=slo_cfg.throughput_floor_tps,
+                rejection_ratio_max=slo_cfg.rejection_ratio_max,
+                stall_budget=slo_cfg.stall_budget,
+            ),
+            windows=(slo_cfg.fast_window, slo_cfg.slow_window),
+            clock=self.clock,
+        )
+        self._slo_task: Optional[asyncio.Task] = None
+        # the probe reads the commit-latency histogram TxTrace already
+        # feeds; get-or-create by name returns that same instrument
+        self._slo_hist = self.registry.histogram("tx_ingress_to_committed")
+        self.registry.gauge(
+            "slo_breaching", "objectives burning above 1.0 in every window",
+            fn=lambda: len(self.slo.breaching()),
+        )
+        self.registry.gauge(
+            "slo_samples", "probe samples held by the SLO engine",
+            fn=lambda: self.slo.sample_count,
+        )
         self.verifier: Optional[Verifier] = None
         self.mesh: Optional[Mesh] = None
         self.broadcast: Optional[Broadcast] = None
@@ -440,6 +470,14 @@ class Service(At2Servicer):
                 service._stats_task = asyncio.create_task(
                     service._stats_loop(obs.stats_interval)
                 )
+            # SLO probe loop only on SERVED nodes: the simulator runs
+            # with serve_rpc=False and evaluates scenario cells offline
+            # (sim adding a standing periodic timer would also blunt its
+            # deadlock detection); live /sloz needs the samples.
+            if serve_rpc and config.slo.enabled:
+                service._slo_task = asyncio.create_task(
+                    service._slo_loop(config.slo.probe_interval)
+                )
             if obs.profile_dir:
                 import jax
 
@@ -499,6 +537,12 @@ class Service(At2Servicer):
             self._stats_task.cancel()
             try:
                 await self._stats_task
+            except asyncio.CancelledError:
+                pass
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            try:
+                await self._slo_task
             except asyncio.CancelledError:
                 pass
         if self._checkpoint_task is not None:
@@ -617,6 +661,45 @@ class Service(At2Servicer):
                 "%s", json.dumps(snap, sort_keys=True, default=float)
             )
 
+    def _stalled_now(self, now: float) -> bool:
+        """Commit-stall predicate shared by /healthz and the SLO probe:
+        some pending payload has been gap-blocked past the catchup
+        trigger horizon."""
+        oldest = min((e[1] for e in self._heap), default=None)
+        stall_horizon = max(self.config.catchup.after * 2, 5.0)
+        return oldest is not None and now - oldest > stall_horizon
+
+    def slo_probe(self) -> None:
+        """Take one SLO probe sample from the registry/TxTrace state the
+        node already maintains. Called by the background loop on served
+        nodes; tests and offline tooling may call it directly."""
+        now = self.clock.monotonic()
+        self.slo.observe(
+            {
+                "t": now,
+                "committed": self.committed,
+                "rejected": self.admission_stats["rejected_at_ingress"],
+                "pending": len(self._heap),
+                "stalled": self._stalled_now(now),
+                "latency": self._slo_hist.buckets(),
+            }
+        )
+
+    async def _slo_loop(self, interval: float) -> None:
+        while True:
+            await self.clock.sleep(interval)
+            try:
+                self.slo_probe()
+            except Exception:
+                logger.exception("slo probe failed")
+
+    def sloz(self) -> dict:
+        """Burn-rate verdicts for GET /sloz."""
+        return {
+            "node": self.config.sign_key.public.hex()[:16],
+            **self.slo.evaluate(),
+        }
+
     # HTTP GET surface, served through PortMux's HTTP/1 keep-alive loop
     # (net/webmux.py): the mux routes GETs here, so scrapes share the
     # grpc-web path's _MAX_HTTP1_CONNS / per-connection request cap /
@@ -665,6 +748,11 @@ class Service(At2Servicer):
                 self.debugz(), sort_keys=True, default=float
             ).encode()
             return 200, self._OBS_JSON, body
+        if route == "/sloz":
+            body = json.dumps(
+                self.sloz(), sort_keys=True, default=float
+            ).encode()
+            return 200, self._OBS_JSON, body
         return None
 
     def tracez(self, limit: int | None = None) -> dict:
@@ -706,20 +794,31 @@ class Service(At2Servicer):
             # peers_needed = threshold - 1 remote channels
             need = max(0, self.broadcast.ready_threshold - 1)
         quorum_ok = peers_total == 0 or channels >= min(need, peers_total)
-        oldest = min((e[1] for e in self._heap), default=None)
-        stall_horizon = max(self.config.catchup.after * 2, 5.0)
-        stalled = oldest is not None and now - oldest > stall_horizon
-        ok = quorum_ok and not stalled and not self._closing
+        stalled = self._stalled_now(now)
+        # SLO degradation folds into the verdict: an objective burning
+        # above 1.0 in BOTH windows (obs/slo.py multi-window AND — a
+        # transient spike cannot flip this) marks the node degraded even
+        # when quorum and the commit heap look healthy.
+        slo_breach = self.slo.breaching(now)
+        ok = (
+            quorum_ok
+            and not stalled
+            and not slo_breach
+            and not self._closing
+        )
         # anomaly-triggered capture: the moment health flips ok->degraded
         # (for a real reason, not shutdown), freeze the flight recorder so
         # the lead-up survives ring rollover. Edge-triggered on the
         # transition, so a poll loop hammering a degraded node takes ONE
         # snapshot per incident, not one per scrape.
         if not ok and self._health_was_ok and not self._closing:
-            self.recorder.snapshot(
-                "healthz_degraded:"
-                + ("stalled" if stalled else "quorum_lost")
-            )
+            if stalled:
+                reason = "stalled"
+            elif not quorum_ok:
+                reason = "quorum_lost"
+            else:
+                reason = "slo:" + ",".join(slo_breach)
+            self.recorder.snapshot("healthz_degraded:" + reason)
         self._health_was_ok = ok
         return {
             "status": "ok" if ok else "degraded",
@@ -728,6 +827,7 @@ class Service(At2Servicer):
             "peers_connected": channels,
             "quorum_ok": quorum_ok,
             "stalled": stalled,
+            "slo_breach": slo_breach,
             "pending": len(self._heap),
             "committed": self.committed,
             "uptime_s": round(now - self._started_at, 3),
@@ -748,6 +848,7 @@ class Service(At2Servicer):
             "stats": self.snapshot_stats(),
             "tx_lifecycle": self.tx_trace.snapshot(),
             "verifier_stages": stages,
+            "slo": self.slo.evaluate(),
         }
 
     # -- delivery → commit loop ------------------------------------------
@@ -1495,8 +1596,12 @@ class Service(At2Servicer):
         rank = self._node_ranks.get(peer.sign_public)
         if rank is None:
             return
+        applied = 0
         for client_id, pubkey in msg.entries:
-            self.directory.apply(client_id, pubkey, rank=rank)
+            if self.directory.apply(client_id, pubkey, rank=rank):
+                applied += 1
+        if applied:
+            self.recorder.record("dir_apply", (applied, rank))
 
     async def Register(self, request, context):
         """Directory registration (at2.proto): assign — or look up — the
@@ -1596,14 +1701,21 @@ class Service(At2Servicer):
             )
         bodies, ids, ok = expanded
         self.distill_stats["distilled_batches_rx"] += 1
+        self.recorder.record("distill_rx", (len(ok),))
         misses = len(ok) - sum(ok)
         if misses:
             self.distill_stats["directory_misses"] += misses
+            # a miss means this node's gossiped directory lags the
+            # assigning node — the usual explanation for broker-era
+            # "frames arrive but nothing commits" stalls, so it earns a
+            # flight-recorder event, not just a counter
+            self.recorder.record("dir_miss", (misses, len(ok)))
         now = self.clock.monotonic()
         seen = self._distill_seen
         E = distill.ENTRY_WIRE
         ad = self.config.admission
         preverify = ad.preverify and self.verifier is not None
+        n_dedup = 0
         kept: List[int] = []
         keys: List[Tuple[int, int]] = []
         for i, cid in enumerate(ids):
@@ -1613,6 +1725,7 @@ class Service(At2Servicer):
             k = (cid, int.from_bytes(bodies[base + 32 : base + 36], "little"))
             if k in seen:
                 self.distill_stats["dedup_drops"] += 1
+                n_dedup += 1
                 continue
             if preverify:
                 bucket = self._admission_refill(f"cid:{cid}", now)
@@ -1621,6 +1734,10 @@ class Service(At2Servicer):
                     continue
             kept.append(i)
             keys.append(k)
+        if n_dedup:
+            # aggregated per frame (not per entry): a replaying broker
+            # must not be able to flood the ring via its own dups
+            self.recorder.record("dedup_drop", (n_dedup, len(ok)))
         if preverify and kept:
             # the v2 transfer preimage is TAG + the first 76 body bytes
             # (sender || seq || recipient || amount — types.py), so a

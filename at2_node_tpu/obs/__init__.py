@@ -1,7 +1,8 @@
 """Observability subsystem: metrics registry, lifecycle tracing, the
-protocol flight recorder, wire exposition. See registry.py / trace.py /
-recorder.py module docstrings and the TECHNICAL.md "Observability" and
-"Fleet tracing & flight recorder" sections for the contracts."""
+protocol flight recorder, the SLO engine, wire exposition. See
+registry.py / trace.py / recorder.py / slo.py module docstrings and the
+TECHNICAL.md "Observability" and "Fleet tracing & flight recorder"
+sections for the contracts."""
 
 from .recorder import FlightRecorder
 from .registry import (
@@ -11,16 +12,22 @@ from .registry import (
     Histogram,
     Registry,
 )
-from .trace import REJECTED, STAGES, TxTrace
+from .slo import Objective, SloEngine, default_objectives, evaluate_point
+from .trace import BROKER_STAGES, REJECTED, STAGES, TxTrace
 
 __all__ = [
+    "BROKER_STAGES",
     "Counter",
     "CounterGroup",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "Objective",
     "REJECTED",
     "Registry",
     "STAGES",
+    "SloEngine",
     "TxTrace",
+    "default_objectives",
+    "evaluate_point",
 ]

@@ -60,7 +60,7 @@ from collections import deque
 
 from .registry import Histogram, Registry
 
-__all__ = ["REJECTED", "STAGES", "TxTrace"]
+__all__ = ["BROKER_STAGES", "REJECTED", "STAGES", "TxTrace"]
 
 STAGES: tuple[str, ...] = (
     "ingress",
@@ -71,6 +71,21 @@ STAGES: tuple[str, ...] = (
     "committed",
 )
 _STAGE_IDX = {s: i for i, s in enumerate(STAGES)}
+
+# Broker-hop relay stamps. The broker tier sits BEFORE node ingress on
+# the distilled path (client → broker _collect → distill → node
+# SendDistilledBatch), so its stages get negative ladder indices: they
+# order ahead of ``ingress`` (index 0) for stitching, while the
+# ``idx <= rec[_IDX]`` monotonicity guard makes node-side records (which
+# start at index >= 0) ignore them for free. Brokers never call
+# ``begin()`` — every broker record is a relay span opened by the keyed
+# lottery, so all parties sample the SAME transactions and
+# trace_collect joins client → broker → node → commit. Deliberately NOT
+# appended to ``STAGES``: the ladder is the node-local happy path and
+# its consumers (histogram construction, snapshot()) iterate it.
+BROKER_STAGES: tuple[str, ...] = ("broker_rx", "broker_flush")
+_STAGE_IDX["broker_rx"] = -2
+_STAGE_IDX["broker_flush"] = -1
 
 # Out-of-ladder terminal: admission control refused the transaction at
 # the RPC boundary (token-bucket throttle or failed pre-verification).
@@ -107,6 +122,7 @@ class TxTrace:
         cap: int = 8192,
         done_cap: int = 1024,
         clock=None,
+        retire_at: str | None = None,
     ) -> None:
         if sample_every < 0:
             raise ValueError("sample_every must be >= 0 (0 disables)")
@@ -114,8 +130,16 @@ class TxTrace:
             raise ValueError("cap must be >= 1")
         if done_cap < 1:
             raise ValueError("done_cap must be >= 1")
+        if retire_at is not None and retire_at not in _STAGE_IDX:
+            raise ValueError(f"unknown retire_at stage {retire_at!r}")
         self._sample_every = sample_every
         self._cap = cap
+        # A non-terminal stage that retires records for THIS tracer.
+        # The broker's tracer sets retire_at="broker_flush": its
+        # custody of a transaction ends at flush, so the record moves to
+        # the completed ring (and /tracez) instead of idling at the live
+        # cap until eviction. Node tracers leave it None.
+        self._retire_at = retire_at
         self._clock = clock if clock is not None else _FallbackClock()
         self._live: dict[tuple, list] = {}
         self._done: deque = deque(maxlen=done_cap)
@@ -191,6 +215,12 @@ class TxTrace:
             self._live[key] = rec = [_STAGE_IDX[stage], t, False, []]
             rec[_STAMPS].append((stage, t, self._clock.wall()))
             self._relayed.inc()
+            if stage == self._retire_at:
+                # e.g. broker_flush for a record evicted between rx and
+                # flush: retire the single-stamp span rather than leave
+                # it live forever
+                self._retire(key, rec, stage)
+                self._completed.inc()
             return
         if stage == REJECTED:
             t = self._clock.monotonic() if now is None else now
@@ -208,8 +238,8 @@ class TxTrace:
             self._hists[stage].observe(t - rec[_T0])
         rec[_IDX] = idx
         rec[_STAMPS].append((stage, t, self._clock.wall()))
-        if stage == "committed":
-            self._retire(key, rec, "committed")
+        if stage == "committed" or stage == self._retire_at:
+            self._retire(key, rec, stage)
             self._completed.inc()
 
     def _retire(self, key: tuple, rec: list, terminal: str) -> None:

@@ -1,0 +1,442 @@
+"""WAN scenario grid: geo topologies × workload shapes × fault mixes,
+each cell measured against its service-level objectives.
+
+Where campaign.py searches for *safety* violations under adversarial
+schedules, this module measures *service quality* under realistic
+conditions: regional WAN latency matrices on the simulated fabric,
+flash-crowd and hot-account traffic shapes, and mid-run partitions.
+Every cell runs the REAL node stack on the deterministic simulator —
+``(seed, cell parameters)`` fully determine the wire trace, so a banked
+cell's ``trace_hash`` is an exact replay receipt, not a ballpark.
+
+A cell's measures come from the same observability surfaces operators
+use live: per-tx commit latency from the stitched ``/tracez`` timelines
+(tools/trace_collect.stitch), commit counts from the ledger, rejection
+counts from admission stats. The SLO verdict reuses the burn-rate
+engine's offline entry point (obs/slo.evaluate_point), so a cell
+breaching in the grid means exactly what ``/sloz`` breaching means on a
+live node.
+
+Driven by tools/scenario_grid.py; scripts/ci.sh runs the 2×2 smoke
+slice and replays one cell to assert the hash reproduces.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import time
+from typing import Dict, List, Optional
+
+from ..obs.slo import default_objectives, evaluate_point
+from .campaign import Event, apply_events
+from .fabric import LinkModel
+from .net import SimNet, sim_client
+
+# -- grid axes -------------------------------------------------------------
+
+TOPOLOGIES = ("lan", "wan3")
+WORKLOADS = ("steady", "flash_crowd", "hot_account")
+FAULT_MIXES = ("none", "cut")
+
+#: the full (topology × workload × faults) matrix
+GRID = [
+    (t, w, fx) for t in TOPOLOGIES for w in WORKLOADS for fx in FAULT_MIXES
+]
+#: the CI smoke slice: LAN/WAN × steady/flash-crowd, no faults
+SMOKE = [
+    (t, w, "none") for t in TOPOLOGIES for w in ("steady", "flash_crowd")
+]
+
+# one-way inter-region latencies (seconds) for the 3-region WAN profile:
+# a near pair (same continent), a transatlantic pair, and a long-haul
+# pair — the 80–250 ms band real geo-replicated deployments live in
+_INTER_REGION = {
+    frozenset((0, 1)): 0.080,
+    frozenset((0, 2)): 0.140,
+    frozenset((1, 2)): 0.250,
+}
+_INTRA = LinkModel(latency=0.002, jitter=0.001)
+
+
+def _seed_int(*parts) -> int:
+    h = hashlib.sha256("\x1f".join(str(p) for p in parts).encode())
+    return int.from_bytes(h.digest()[:8], "big")
+
+
+def apply_topology(net: SimNet, topology: str) -> None:
+    """Install the geo profile's per-link models on the net's fabric.
+    ``lan`` keeps the uniform default; ``wan3`` pins node i to region
+    i % 3 and gives every directed inter-region link its pair's one-way
+    latency with 10% jitter (jitter is what makes equal-latency links
+    reorder, so it stays proportional to the haul)."""
+    if topology == "lan":
+        return
+    if topology != "wan3":
+        raise ValueError(f"unknown topology {topology!r}")
+    signs = [cfg.sign_key.public for cfg in net.configs]
+    for i, a in enumerate(signs):
+        for j, b in enumerate(signs):
+            if i == j:
+                continue
+            ra, rb = i % 3, j % 3
+            if ra == rb:
+                model = _INTRA
+            else:
+                lat = _INTER_REGION[frozenset((ra, rb))]
+                model = LinkModel(latency=lat, jitter=lat * 0.1)
+            net.fabric.set_link(a, b, model)
+
+
+# -- workload generators ---------------------------------------------------
+
+
+def _finish_txs(
+    rng: random.Random, raw: List[tuple], n_clients: int
+) -> List[Event]:
+    """Turn (t, node, client) triples into ``tx`` events: sort by time,
+    then assign each sender's sequences in arrival order — a sender's
+    seqs are time-ordered, so nothing parks at the sequence gate longer
+    than its own pipeline depth."""
+    raw = sorted(
+        (round(t, 3), node, client) for t, node, client in raw
+    )
+    next_seq = [1] * n_clients
+    events: List[Event] = []
+    for t, node, client in raw:
+        to = rng.randrange(n_clients)
+        events.append(
+            [
+                t,
+                "tx",
+                {
+                    "node": node,
+                    "client": client,
+                    "seq": next_seq[client],
+                    "to": to,
+                    "amount": rng.randint(1, 20),
+                },
+            ]
+        )
+        next_seq[client] += 1
+    return events
+
+
+def steady_workload(
+    rng: random.Random, *, nodes: int, n_clients: int, n_tx: int,
+    duration: float,
+) -> List[Event]:
+    """Evenly paced traffic: senders round-robin, arrival times jittered
+    around a uniform schedule — the baseline every other shape is
+    measured against."""
+    step = duration / max(1, n_tx)
+    raw = [
+        (
+            min(duration, max(0.0, i * step + rng.uniform(0, step * 0.5))),
+            rng.randrange(nodes),
+            i % n_clients,
+        )
+        for i in range(n_tx)
+    ]
+    return _finish_txs(rng, raw, n_clients)
+
+
+def flash_crowd_workload(
+    rng: random.Random, *, nodes: int, n_clients: int, n_tx: int,
+    duration: float,
+) -> List[Event]:
+    """A burst riding on baseline traffic: half the volume arrives in a
+    window one-tenth of the run (a ~10× instantaneous rate spike) —
+    the viral-moment shape that exposes queueing and quorum-stall
+    behavior a steady offered rate never does."""
+    n_burst = n_tx // 2
+    n_base = n_tx - n_burst
+    burst_at = duration * 0.45
+    burst_len = duration * 0.10
+    raw = [
+        (rng.uniform(0.0, duration), rng.randrange(nodes), i % n_clients)
+        for i in range(n_base)
+    ]
+    raw += [
+        (
+            burst_at + rng.uniform(0.0, burst_len),
+            rng.randrange(nodes),
+            i % n_clients,
+        )
+        for i in range(n_burst)
+    ]
+    return _finish_txs(rng, raw, n_clients)
+
+
+def hot_account_workload(
+    rng: random.Random, *, nodes: int, n_clients: int, n_tx: int,
+    duration: float,
+) -> List[Event]:
+    """Skewed senders: client 0 originates ~40% of all traffic. Because
+    a sender's transfers serialize through its sequence gate, the hot
+    account's tail latency grows with its pipeline depth while everyone
+    else stays cheap — the fairness index and the p99/p50 gap are the
+    signals this shape exists to produce."""
+    raw = []
+    for i in range(n_tx):
+        client = 0 if rng.random() < 0.4 else 1 + rng.randrange(n_clients - 1)
+        raw.append((rng.uniform(0.0, duration), rng.randrange(nodes), client))
+    return _finish_txs(rng, raw, n_clients)
+
+
+_WORKLOAD_FNS = {
+    "steady": steady_workload,
+    "flash_crowd": flash_crowd_workload,
+    "hot_account": hot_account_workload,
+}
+
+
+def fault_events(
+    faults: str, *, duration: float
+) -> List[Event]:
+    """The cell's fault mix. ``cut`` partitions nodes 0↔1 for 3 virtual
+    seconds mid-run — f=1 keeps commits flowing through the remaining
+    quorum, and totality after heal is part of what the invariant check
+    asserts."""
+    if faults == "none":
+        return []
+    if faults == "cut":
+        return [
+            [round(duration * 0.35, 3), "cut",
+             {"a": 0, "b": 1, "duration": 3.0}]
+        ]
+    raise ValueError(f"unknown fault mix {faults!r}")
+
+
+# -- SLO targets per cell --------------------------------------------------
+
+# ingress→fleet-commit p99 ceilings (ms). WAN rounds cost 2–3 long-haul
+# RTTs; hot-account tails additionally stack the hot sender's pipeline
+# depth on top of the per-commit round trip.
+_LATENCY_P99_MS = {
+    ("lan", "steady"): 250.0,
+    ("lan", "flash_crowd"): 500.0,
+    ("lan", "hot_account"): 1000.0,
+    ("wan3", "steady"): 1500.0,
+    ("wan3", "flash_crowd"): 2500.0,
+    ("wan3", "hot_account"): 5000.0,
+}
+
+
+def cell_objectives(topology: str, workload: str):
+    """The cell's declarative objectives — same Objective/evaluate_point
+    machinery a live node serves on /sloz, targets scaled to the cell's
+    physics (a WAN hot-account cell is *supposed* to be slow; it is not
+    supposed to reject or stall)."""
+    return default_objectives(
+        latency_p99_ms=_LATENCY_P99_MS[(topology, workload)],
+        throughput_floor_tps=0.2,
+        rejection_ratio_max=0.02,
+        stall_budget=0.25,
+    )
+
+
+def jain_index(xs: List[float]) -> float:
+    """Jain's fairness index over per-sender commit counts: 1.0 = all
+    senders progressed equally, 1/n = one sender got everything."""
+    total = sum(xs)
+    if not xs or total <= 0:
+        return 1.0
+    return (total * total) / (len(xs) * sum(x * x for x in xs))
+
+
+# -- the cell runner -------------------------------------------------------
+
+
+def run_cell(
+    seed: int,
+    topology: str = "lan",
+    workload: str = "steady",
+    faults: str = "none",
+    *,
+    nodes: int = 4,
+    f: int = 1,
+    n_clients: int = 6,
+    n_tx: int = 48,
+    duration: float = 12.0,
+    settle_horizon: float = 150.0,
+    capture_trace: bool = False,
+) -> dict:
+    """One grid cell: fresh SimNet with the topology's link matrix, the
+    workload's schedule plus the fault mix, run + settle, then measure
+    throughput / latency / fairness from the fleet's own observability
+    surfaces and evaluate the cell's SLOs. Pure in ``(seed, params)``.
+
+    ``capture_trace`` attaches the full stitched timeline (big; the
+    grid driver keeps it off for banked cells and on for --inspect)."""
+    from ..tools.trace_collect import _pctl, stitch  # lazy: tools→sim
+    # is the import direction elsewhere; avoid the cycle
+
+    wall0 = time.monotonic()
+    rng = random.Random(_seed_int("cell", seed, topology, workload, faults))
+    net = SimNet(nodes, f, seed, hostile=0, link=_INTRA)
+    apply_topology(net, topology)
+    net.start()
+    try:
+        clients = [sim_client(seed, i) for i in range(n_clients)]
+        events = _WORKLOAD_FNS[workload](
+            rng, nodes=nodes, n_clients=n_clients, n_tx=n_tx,
+            duration=duration,
+        )
+        offered_by_client = [0] * n_clients
+        for _t, _k, args in events:
+            offered_by_client[args["client"]] += 1
+        events = events + fault_events(faults, duration=duration)
+        events.sort(key=lambda e: (e[0], e[1]))
+        apply_events(net, events, clients, None)
+        last_t = max((e[0] for e in events), default=0.0)
+        net.run_for(last_t + 1.0)
+        net.fabric.heal_all()
+        settle_t = net.settle(horizon=settle_horizon)
+        violations = net.check_invariants()
+
+        offered = sum(offered_by_client)
+        committed = min(s.committed for s in net.services)
+        rejected = sum(
+            s.admission_stats["rejected_at_ingress"] for s in net.services
+        )
+        # throughput over the ACTIVE window: injection plus settle time
+        # minus the trailing stability windows settle() spends proving
+        # quiescence (stable=4 × window=5.0 defaults) — idle tail is
+        # proof work, not service time
+        active_s = last_t + 1.0 + max(0.0, settle_t - 20.0)
+        throughput = committed / active_s if active_s > 0 else 0.0
+
+        stitched = stitch([s.tracez() for s in net.services])
+        lats = []
+        for tx in stitched["txs"]:
+            if tx["terminal"] != "committed":
+                continue
+            commit_rels = [
+                rel
+                for span in tx["spans"]
+                for s, rel in span["stages"]
+                if s == "committed"
+            ]
+            if commit_rels:
+                lats.append(max(commit_rels))
+        lats.sort()
+        lat_p50 = round(1e3 * _pctl(lats, 0.50), 3)
+        lat_p99 = round(1e3 * _pctl(lats, 0.99), 3)
+
+        frontier = net.services[0].accounts.frontier_nowait()
+        commit_counts = [
+            float(frontier.get(clients[c].public, 0))
+            for c in range(n_clients)
+            if offered_by_client[c] > 0
+        ]
+        fairness = round(jain_index(commit_counts), 6)
+        rejection_ratio = round(rejected / offered, 6) if offered else 0.0
+        stall_fraction = (
+            1.0 if (settle_t >= settle_horizon or committed < offered)
+            else 0.0
+        )
+
+        slo = evaluate_point(
+            cell_objectives(topology, workload),
+            {
+                "throughput_tps": throughput,
+                "latency_p99_ms": lat_p99,
+                "rejection_ratio": rejection_ratio,
+                "stall_fraction": stall_fraction,
+            },
+        )
+        cell = {
+            "topology": topology,
+            "workload": workload,
+            "faults": faults,
+            "seed": seed,
+            "nodes": nodes,
+            "f": f,
+            "offered": offered,
+            "committed": committed,
+            "rejected": rejected,
+            "throughput_tps": round(throughput, 3),
+            "latency_p50_ms": lat_p50,
+            "latency_p99_ms": lat_p99,
+            "fairness": fairness,
+            "rejection_ratio": rejection_ratio,
+            "stall_fraction": stall_fraction,
+            "virtual_time": round(last_t + 1.0 + settle_t, 3),
+            "wall_seconds": round(time.monotonic() - wall0, 3),
+            "trace_hash": net.fabric.trace_hash(),
+            "violations": violations,
+            "slo": slo,
+            "ok": bool(not violations and slo["ok"]),
+        }
+        if capture_trace:
+            cell["stitched"] = stitched
+        return cell
+    finally:
+        net.close()
+
+
+def run_grid(
+    seed: int,
+    cells: Optional[List[tuple]] = None,
+    *,
+    nodes: int = 4,
+    f: int = 1,
+    n_clients: int = 6,
+    n_tx: int = 48,
+    duration: float = 12.0,
+    progress=None,
+) -> dict:
+    """Run every (topology, workload, faults) cell — the full GRID by
+    default — and fold the per-cell trace hashes into one grid hash,
+    the determinism fingerprint CI compares across same-seed runs. The
+    per-cell seed derives from the grid seed + the cell's coordinates,
+    so any single cell replays standalone via :func:`run_cell`."""
+    cells = list(GRID if cells is None else cells)
+    results: List[dict] = []
+    for coords in cells:
+        topology, workload, faults = coords
+        cell_seed = _seed_int("grid", seed, topology, workload, faults) % (
+            1 << 32
+        )
+        cell = run_cell(
+            cell_seed, topology, workload, faults,
+            nodes=nodes, f=f, n_clients=n_clients, n_tx=n_tx,
+            duration=duration,
+        )
+        results.append(cell)
+        if progress is not None:
+            progress(cell)
+    h = hashlib.sha256()
+    for cell in results:
+        h.update(cell["trace_hash"].encode())
+    return {
+        "grid_seed": seed,
+        "nodes": nodes,
+        "f": f,
+        "n_clients": n_clients,
+        "n_tx": n_tx,
+        "duration": duration,
+        "cells": results,
+        "grid_hash": h.hexdigest(),
+        "breaching": [
+            f"{c['topology']}/{c['workload']}/{c['faults']}"
+            for c in results
+            if not c["ok"]
+        ],
+    }
+
+
+__all__ = [
+    "FAULT_MIXES",
+    "GRID",
+    "SMOKE",
+    "TOPOLOGIES",
+    "WORKLOADS",
+    "apply_topology",
+    "cell_objectives",
+    "fault_events",
+    "jain_index",
+    "run_cell",
+    "run_grid",
+]

@@ -18,6 +18,12 @@ instead of the table. In watch mode a node that fails to answer renders
 as DOWN and keeps the loop alive — mid-restart nodes are exactly when
 you want the dashboard up.
 
+Broker addresses can be polled alongside nodes: a /statusz that reports
+``role: broker`` renders a broker-shaped row (forwarded transfers/s,
+flush-build latency, pending buffer against PENDING_CAP with a ``!``
+backpressure marker, dedup/overflow/forward-error drops) and its
+health verdict participates in ``--once`` gating like any node's.
+
 ``--tracez`` switches the whole tool into a tail: it polls each node's
 /tracez and prints every NEWLY completed lifecycle trace (one line per
 tx: terminal, total latency, per-stage offsets) — `tail -f` for the
@@ -94,6 +100,44 @@ def render_frame(rows, now: float, prev) -> str:
     for addr, sz in rows:
         if isinstance(sz, Exception):
             lines.append(f"{addr:<22}{'DOWN':<9}{type(sz).__name__}: {sz}")
+            continue
+        if sz.get("role") == "broker":
+            # broker-shaped row: no quorum, no ledger — what matters is
+            # the pending buffer against its cap, flush-build latency,
+            # and the forward/drop counters
+            health = sz.get("health", {})
+            stats = sz.get("stats", {})
+            flush = sz.get("flush", {})
+            fwd = _num(stats, "broker_entries_tx")
+            rate = ""
+            seen = prev.get(addr)
+            if seen is not None and now > seen[0]:
+                rate = f"{(fwd - seen[1]) / (now - seen[0]):.1f}"
+            pend = (
+                f"{_num(health, 'pending')}/{_num(health, 'pending_cap')}"
+                + ("!" if health.get("backpressure") else "")
+            )
+            drops = (
+                f"{_num(stats, 'broker_dedup_drops')}/"
+                f"{_num(stats, 'broker_overflow_drops')}/"
+                f"{_num(stats, 'broker_forward_errors')}"
+            )
+            lines.append(
+                f"{addr:<22}"
+                f"{health.get('status', '?'):<9}"
+                f"{rate:>8}"
+                f"{fwd:>11}"
+                f"{_num(flush, 'p50_ms'):>9.1f}"
+                f"{_num(flush, 'p99_ms'):>9.1f}"
+                f"{'broker':>9}"
+                f"{_num(stats, 'broker_batches_tx'):>9}"
+                f"{'':>6}"
+                f"{'-':>9}"
+                f"{'-':>12}"
+                f"{pend:>9}"
+                f"{drops:>15}"
+                f"{_num(stats, 'broker_registrations'):>7}"
+            )
             continue
         stats = sz.get("stats", {})
         health = sz.get("health", {})
@@ -219,7 +263,14 @@ async def run(addrs, interval: float, once: bool, clear: bool,
             print(frame, file=out, flush=True)
         for addr, sz in rows:
             if not isinstance(sz, Exception):
-                prev[addr] = (now, _num(sz.get("health", {}), "committed"))
+                # the rate basis: commits for nodes, forwarded transfers
+                # for broker rows
+                basis = (
+                    _num(sz.get("stats", {}), "broker_entries_tx")
+                    if sz.get("role") == "broker"
+                    else _num(sz.get("health", {}), "committed")
+                )
+                prev[addr] = (now, basis)
         if once:
             # scripting/CI contract: nonzero when ANY polled node is
             # unreachable or self-reports degraded health — a fleet

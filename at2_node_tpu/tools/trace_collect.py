@@ -46,12 +46,15 @@ import sys
 
 from .top import fetch_json
 
-# ladder order for sorting stages within a (tx, node) span; rejected
-# sits past committed (both are terminal, a record holds at most one)
+# ladder order for sorting stages within a (tx, node) span; the broker
+# hop precedes node ingress on the distilled path; rejected sits past
+# committed (both are terminal, a record holds at most one)
 _STAGE_ORDER = {
     s: i
     for i, s in enumerate(
         (
+            "broker_rx",
+            "broker_flush",
             "ingress",
             "admitted",
             "echoed",
@@ -62,6 +65,10 @@ _STAGE_ORDER = {
         )
     )
 }
+# the broker-hop latency decomposition: queue = sitting in the broker's
+# pending buffer + distillation, handoff = flush → first node ingress
+# (RPC + node-side verify/expand), plane = ingress → fleet-wide commit
+_BROKER_SEGMENTS = ("queue_ms", "handoff_ms", "plane_ms", "total_ms")
 # quorum stages: the LAST node to reach one is the straggler that
 # bounded the fleet-wide latency of that phase
 _STRAGGLER_STAGES = ("echoed", "ready_quorum", "delivered", "committed")
@@ -92,6 +99,9 @@ def stitch(dumps: list) -> dict:
     stage_rel: dict = {}  # stage -> [relative seconds across (tx, node)]
     straggler_counts: dict = {}  # stage -> node -> times it was last
     n_committed = n_stitched_committed = n_with_origin = 0
+    n_with_broker = 0
+    broker_seg: dict = {s: [] for s in _BROKER_SEGMENTS}
+    broker_bottlenecks: dict = {}
     for key in sorted(txs):
         per_node = txs[key]
         origin_node = None
@@ -153,23 +163,76 @@ def stitch(dumps: list) -> dict:
                 stragglers[s] = [hit[1], hit[0]]
                 straggler_counts.setdefault(s, {}).setdefault(hit[1], 0)
                 straggler_counts[s][hit[1]] += 1
+        # broker-hop decomposition: txs whose span set includes a broker
+        # relay record get their end-to-end latency split into
+        # queue (broker_rx→broker_flush), handoff (flush→first node
+        # ingress), plane (ingress→fleet-wide commit). The dominant
+        # segment is the hop's straggler attribution.
+        broker_hop = None
+        rx = flush = ingress_rel = commit_rel = None
+        for rec in per_node.values():
+            for s, _m, w in rec["stages"]:
+                rel = w - t0
+                if s == "broker_rx":
+                    rx = rel if rx is None else min(rx, rel)
+                elif s == "broker_flush":
+                    flush = rel if flush is None else min(flush, rel)
+                elif s == "ingress":
+                    ingress_rel = (
+                        rel if ingress_rel is None else min(ingress_rel, rel)
+                    )
+                elif s == "committed":
+                    commit_rel = (
+                        rel if commit_rel is None else max(commit_rel, rel)
+                    )
+        if rx is not None:
+            n_with_broker += 1
+            broker_hop = {"rx": round(rx, 9)}
+            segs = {}
+            if flush is not None:
+                broker_hop["flush"] = round(flush, 9)
+                segs["queue_ms"] = round((flush - rx) * 1e3, 6)
+                if ingress_rel is not None:
+                    segs["handoff_ms"] = round(
+                        (ingress_rel - flush) * 1e3, 6
+                    )
+            if ingress_rel is not None and commit_rel is not None:
+                segs["plane_ms"] = round(
+                    (commit_rel - ingress_rel) * 1e3, 6
+                )
+            if commit_rel is not None:
+                segs["total_ms"] = round((commit_rel - rx) * 1e3, 6)
+            broker_hop.update(segs)
+            for seg, v in segs.items():
+                broker_seg[seg].append(v)
+            ranked_segs = [
+                (seg, segs[seg])
+                for seg in ("queue_ms", "handoff_ms", "plane_ms")
+                if seg in segs
+            ]
+            if ranked_segs and "total_ms" in segs:
+                bottleneck = max(ranked_segs, key=lambda kv: kv[1])[0]
+                broker_hop["bottleneck"] = bottleneck
+                broker_bottlenecks.setdefault(bottleneck, 0)
+                broker_bottlenecks[bottleneck] += 1
         if committed:
             n_committed += 1
             if len(per_node) > 1:
                 n_stitched_committed += 1
         if origin_node is not None:
             n_with_origin += 1
-        out_txs.append(
-            {
-                "sender": key[0],
-                "seq": key[1],
-                "origin_node": origin_node,
-                "terminal": terminal,
-                "nodes": len(per_node),
-                "spans": spans,
-                "stragglers": stragglers,
-            }
-        )
+        tx_out = {
+            "sender": key[0],
+            "seq": key[1],
+            "origin_node": origin_node,
+            "terminal": terminal,
+            "nodes": len(per_node),
+            "spans": spans,
+            "stragglers": stragglers,
+        }
+        if broker_hop is not None:
+            tx_out["broker_hop"] = broker_hop
+        out_txs.append(tx_out)
     summary_stages = {}
     for s in sorted(stage_rel):
         vals = sorted(stage_rel[s])
@@ -179,6 +242,20 @@ def stitch(dumps: list) -> dict:
             "p99_ms": round(1e3 * _pctl(vals, 0.99), 6),
             "max_ms": round(1e3 * vals[-1], 6) if vals else 0.0,
         }
+    broker_summary = {
+        "txs": n_with_broker,
+        "segments": {
+            seg: {
+                "count": len(vals),
+                "p50_ms": round(_pctl(sorted(vals), 0.50), 6),
+                "p99_ms": round(_pctl(sorted(vals), 0.99), 6),
+                "max_ms": round(max(vals), 6) if vals else 0.0,
+            }
+            for seg, vals in broker_seg.items()
+            if vals
+        },
+        "bottleneck_counts": dict(sorted(broker_bottlenecks.items())),
+    }
     return {
         "nodes": sorted(d.get("node", "?") for d in dumps),
         "coverage": {
@@ -186,12 +263,14 @@ def stitch(dumps: list) -> dict:
             "committed": n_committed,
             "stitched_committed": n_stitched_committed,
             "with_origin": n_with_origin,
+            "with_broker": n_with_broker,
         },
         "stages": summary_stages,
         "straggler_counts": {
             s: dict(sorted(c.items()))
             for s, c in sorted(straggler_counts.items())
         },
+        "broker_hop": broker_summary,
         "txs": out_txs,
     }
 
@@ -205,7 +284,8 @@ def render_summary(stitched: dict) -> str:
         f"transactions: {cov['txs']} "
         f"(committed {cov['committed']}, "
         f"stitched across >1 node {cov['stitched_committed']}, "
-        f"with origin ingress {cov['with_origin']})",
+        f"with origin ingress {cov['with_origin']}, "
+        f"via broker {cov.get('with_broker', 0)})",
         "",
         f"{'stage':<14}{'spans':>7}{'p50 ms':>10}{'p99 ms':>10}"
         f"{'max ms':>10}",
@@ -224,6 +304,33 @@ def render_summary(stitched: dict) -> str:
             lines.append(
                 f"  {s:<13}"
                 + "  ".join(f"{n}×{c}" for n, c in ranked)
+            )
+    bh = stitched.get("broker_hop", {})
+    if bh.get("txs"):
+        lines.append("")
+        lines.append(
+            f"broker hop ({bh['txs']} txs, "
+            "queue = broker buffer+distill, handoff = flush→ingress, "
+            "plane = ingress→commit):"
+        )
+        lines.append(
+            f"  {'segment':<12}{'txs':>7}{'p50 ms':>10}{'p99 ms':>10}"
+            f"{'max ms':>10}"
+        )
+        for seg in _BROKER_SEGMENTS:
+            row = bh["segments"].get(seg)
+            if row is None:
+                continue
+            lines.append(
+                f"  {seg:<12}{row['count']:>7}{row['p50_ms']:>10.3f}"
+                f"{row['p99_ms']:>10.3f}{row['max_ms']:>10.3f}"
+            )
+        bn = bh.get("bottleneck_counts", {})
+        if bn:
+            ranked = sorted(bn.items(), key=lambda kv: (-kv[1], kv[0]))
+            lines.append(
+                "  dominant segment: "
+                + "  ".join(f"{s}×{c}" for s, c in ranked)
             )
     return "\n".join(lines)
 

@@ -141,6 +141,32 @@ if [ -z "$b1" ] || [ "$b1" != "$b2" ]; then
 fi
 echo "same-seed broker campaign hash reproduced: $b1"
 
+echo "== scenario-grid smoke gate =="
+# Fleet SLO engine + scenario grid (ISSUE 8): the 2x2 smoke slice
+# (lan/wan3 x steady/flash_crowd) must commit every offered transfer,
+# pass every per-cell SLO verdict (exit 0), and reproduce its grid hash
+# (sha256 over per-cell trace hashes) byte-identically run to run —
+# same contract as the campaign determinism gates above.
+grid_hash() {
+  python -m at2_node_tpu.tools.scenario_grid --seed 7 --smoke \
+    --txs 24 --duration 8 --quiet | sed -n 's/.*hash \([0-9a-f]*\).*/\1/p'
+}
+g1="$(grid_hash)"
+g2="$(grid_hash)"
+if [ -z "$g1" ] || [ "$g1" != "$g2" ]; then
+  echo "scenario-grid gate FAILED: '$g1' != '$g2'" >&2
+  exit 1
+fi
+echo "same-seed scenario grid hash reproduced: $g1"
+
+echo "== observability overhead gate =="
+# The full tracer + recorder + SLO probe cost, measured as plane
+# throughput with observability on vs off (interleaved arms, best-of-N
+# per arm to shed scheduler noise), must stay under the 5% budget.
+# Exit nonzero when the obs-on arm regresses past --budget.
+python -m at2_node_tpu.tools.plane_bench --compare-obs --nodes 3 \
+    --txs 200 --repeat 2 --out /dev/null
+
 if [ "$tier" = "all" ]; then
   echo "== native sanitizers (TSAN + ASAN) =="
   # the reference gets race-freedom from Rust; the C++ prep library gets

@@ -442,6 +442,53 @@ class TestBrokerRoundtrip:
             assert services[0].distill_stats["distilled_batches_rx"] >= 1
             assert broker.stats["broker_entries_tx"] == 20
             assert broker.stats["broker_batches_tx"] >= 1
+
+            # broker-hop causal tracing: the broker's relay spans carry
+            # the same (sender, seq) keys as the node spans, so stitch()
+            # joins client→broker→node→commit and decomposes the hop
+            import json
+
+            from at2_node_tpu.tools.trace_collect import stitch
+
+            status, _, body = broker.obs_http("/tracez")
+            assert status == 200
+            broker_dump = json.loads(body)
+            assert broker_dump["node"] == f"broker:{broker.node_uri}"
+            st = stitch([s.tracez() for s in services] + [broker_dump])
+            assert st["coverage"]["with_broker"] >= 1
+            hop_txs = [t for t in st["txs"] if "broker_hop" in t]
+            assert hop_txs
+            hop = hop_txs[0]["broker_hop"]
+            # queue (rx→flush) + handoff (flush→ingress) + plane
+            # (ingress→commit) cover the end-to-end total
+            assert {"queue_ms", "handoff_ms", "plane_ms", "total_ms",
+                    "bottleneck"} <= set(hop)
+            assert hop["queue_ms"] >= 0 and hop["handoff_ms"] >= 0
+            assert hop["total_ms"] >= hop["plane_ms"] > 0
+            segs = st["broker_hop"]["segments"]
+            assert segs["total_ms"]["count"] == len(hop_txs)
+
+            # broker health: ok far from PENDING_CAP, verdict embedded
+            # in /statusz for the top.py broker row
+            status, _, body = broker.obs_http("/healthz")
+            assert status == 200
+            hv = json.loads(body)
+            assert hv["status"] == "ok" and hv["backpressure"] is False
+            assert hv["role"] == "broker" and hv["pending"] == 0
+            status, _, body = broker.obs_http("/statusz")
+            sz = json.loads(body)
+            assert sz["role"] == "broker"
+            assert sz["health"]["status"] == "ok"
+            assert sz["flush"]["count"] >= 1
+
+            # satellite recorder codes: broker flush decisions, node
+            # distilled-ingress events
+            broker_codes = {e[1] for e in broker.recorder.dump()["events"]}
+            assert "flush" in broker_codes
+            node_codes = {
+                e[1] for e in services[0].recorder.dump()["events"]
+            }
+            assert "distill_rx" in node_codes
         finally:
             await broker.close()
             for s in services:

@@ -499,6 +499,7 @@ class TestTraceTools:
         assert st["coverage"] == {
             "txs": 1, "committed": 1,
             "stitched_committed": 1, "with_origin": 1,
+            "with_broker": 0,
         }
         (tx,) = st["txs"]
         assert tx["origin_node"] == "n0" and tx["nodes"] == 2
@@ -511,6 +512,42 @@ class TestTraceTools:
         # pure: same dumps in, byte-identical JSON out
         assert json.dumps(st, sort_keys=True) == json.dumps(
             stitch([relay, origin]), sort_keys=True
+        )
+
+    def test_stitch_broker_hop_decomposition(self):
+        from at2_node_tpu.tools.trace_collect import stitch
+
+        # the broker saw the tx first (rx at t=-0.04 relative to node
+        # ingress), flushed at -0.01; the node committed at +0.05 — the
+        # hop decomposes into queue 30ms, handoff 10ms, plane 50ms
+        broker = self._dump("broker:127.0.0.1:9", [self._rec(
+            1, False,
+            [["broker_rx", 0.0, 99.96], ["broker_flush", 0.03, 99.99]],
+            terminal="broker_flush",
+        )])
+        node = self._dump("n0", [self._rec(
+            1, True,
+            [["ingress", 0.0, 100.0], ["committed", 0.05, 100.05]],
+        )])
+        st = stitch([node, broker])
+        assert st["coverage"]["with_broker"] == 1
+        (tx,) = st["txs"]
+        hop = tx["broker_hop"]
+        # rels normalize to the ORIGIN ingress stamp: the broker stages
+        # land at negative offsets (custody precedes node ingress)
+        assert hop["rx"] == -0.04 and hop["flush"] == -0.01
+        assert hop["queue_ms"] == 30.0
+        assert hop["handoff_ms"] == 10.0
+        assert hop["plane_ms"] == 50.0
+        assert hop["total_ms"] == 90.0
+        assert hop["bottleneck"] == "plane_ms"
+        seg = st["broker_hop"]["segments"]
+        assert seg["total_ms"]["count"] == 1
+        assert seg["total_ms"]["p99_ms"] == 90.0
+        assert st["broker_hop"]["bottleneck_counts"] == {"plane_ms": 1}
+        # pure: same dumps in, byte-identical JSON out
+        assert json.dumps(st, sort_keys=True) == json.dumps(
+            stitch([node, broker]), sort_keys=True
         )
 
     def test_chrome_trace_shape(self):
@@ -666,6 +703,7 @@ class TestEndpoints:
         ) as node:
             for path in (
                 "/metrics", "/healthz", "/statusz", "/tracez", "/debugz",
+                "/sloz",
             ):
                 status, _, _ = await _get(node.config.rpc_address, path)
                 assert status == 404
@@ -716,6 +754,56 @@ class TestEndpoints:
             # its echo decision and ready-quorum delivery edge, and the
             # attestation send path fired
             assert {"batch_echo", "batch_deliver", "tx"} <= codes
+
+    async def test_sloz_serves_burn_rate_verdicts(self):
+        async with _Node() as node:
+            addr = node.config.rpc_address
+            async with Client(f"http://{addr}") as client:
+                sender = SignKeyPair.random()
+                await client.send_asset(
+                    sender, 1, SignKeyPair.random().public, 5
+                )
+                deadline = asyncio.get_event_loop().time() + TIMEOUT
+                while await client.get_last_sequence(sender.public) != 1:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(TICK)
+            # two direct probes bracket the commit so the engine holds a
+            # window regardless of the probe loop's own cadence
+            node.service.slo_probe()
+            await asyncio.sleep(0.01)
+            node.service.slo_probe()
+
+            status, headers, body = await _get(addr, "/sloz")
+            assert status == 200
+            assert headers["content-type"].startswith("application/json")
+            z = json.loads(body)
+            assert set(z) >= {
+                "node", "windows_s", "samples", "objectives", "breaching",
+            }
+            assert z["samples"] >= 2
+            # the default throughput floor is 0.0 = disabled (an idle
+            # node has no committed rate to hold)
+            kinds = {o["kind"] for o in z["objectives"]}
+            assert kinds == {
+                "latency_p99", "rejection_ratio", "stall_budget",
+            }
+            for o in z["objectives"]:
+                assert {"name", "kind", "target", "status", "windows"} <= set(o)
+                assert len(o["windows"]) == 2
+            # one committed tx in milliseconds on localhost: a healthy
+            # idle-ish node must NOT breach the default objectives
+            assert z["breaching"] == []
+
+            # the degradation verdict folds the SLO state in
+            status, _, body = await _get(addr, "/healthz")
+            assert status == 200
+            health = json.loads(body)
+            assert health["status"] == "ok"
+            assert health["slo_breach"] == []
+
+            # /statusz carries the same evaluation for the dashboard
+            status, _, body = await _get(addr, "/statusz")
+            assert json.loads(body)["slo"]["breaching"] == []
 
     async def test_recorder_disabled_by_cap_zero(self):
         async with _Node(
