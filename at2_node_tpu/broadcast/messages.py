@@ -24,6 +24,7 @@ layout).
 from __future__ import annotations
 
 import hashlib
+import json
 import struct
 from dataclasses import dataclass
 
@@ -58,6 +59,12 @@ BATCH_REQ = 12  # content pull for a quorate batch never gossiped here
 # announces are unsigned and accepted only over authenticated channels,
 # same trust shape as the catchup plane.
 DIR_ANNOUNCE = 13  # (announcing node, [(client_id, pubkey)...])
+# Membership reconfiguration (node/membership.py): an admin-signed epoch
+# transition — add/remove nodes, re-weight quorum thresholds. Gossiped
+# like any other message and re-gossiped on first acceptance so every
+# node converges on the new epoch; messages from epochs older than the
+# grace window are rejected (stack.py / membership.py).
+CONFIG_TX = 14  # (epoch, admin signature, JSON change description)
 
 _PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
 _ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
@@ -71,6 +78,7 @@ _BATCH_ATT = struct.Struct("<32s32sQ32sI")  # origin, b_origin, b_seq, hash, bm 
 _BATCH_REQ = struct.Struct("<32sQ32s")  # batch origin, batch_seq, hash
 _DIR_HDR = struct.Struct("<32sI")  # announcing node, entry count
 _DIR_ENTRY = struct.Struct("<Q32s")  # client id, client pubkey
+_CONFIG_HDR = struct.Struct("<QI64s")  # epoch, body length, admin sig
 
 PAYLOAD_WIRE = 1 + _PAYLOAD.size
 ATTEST_WIRE = 1 + _ATTEST.size
@@ -83,10 +91,16 @@ BATCH_HDR_WIRE = 1 + _BATCH_HDR.size  # variable: header + count entries
 BATCH_ATT_WIRE = 1 + _BATCH_ATT.size + 64  # variable: + bitmap before sig
 BATCH_REQ_WIRE = 1 + _BATCH_REQ.size
 DIR_HDR_WIRE = 1 + _DIR_HDR.size  # variable: header + count entries
+CONFIG_HDR_WIRE = 1 + _CONFIG_HDR.size  # variable: header + JSON body
 
 # Bounds one announce's parse amplification (a full directory re-sync
 # splits across several announces).
 MAX_DIR_ENTRIES = 4096
+
+# A config transaction describes a handful of membership rows; anything
+# larger is malformed (must match kMaxConfigBytes in
+# native/at2_ingest.cpp).
+MAX_CONFIG_BYTES = 4096
 
 # Hard cap on entries per batch (bounds bitmap width, parse amplification,
 # and the per-slot verify burst); the ingress batcher flushes well below
@@ -105,6 +119,7 @@ _READY_TAG = b"at2-node-tpu/ready/v1"
 _BATCH_TAG = b"at2-node-tpu/batch/v1"
 _BECHO_TAG = b"at2-node-tpu/batch-echo/v1"
 _BREADY_TAG = b"at2-node-tpu/batch-ready/v1"
+_CONFIG_TAG = b"at2-node-tpu/config-tx/v1"
 
 
 class WireError(Exception):
@@ -565,6 +580,62 @@ class DirectoryAnnounce:
         return DirectoryAnnounce(origin, entries)
 
 
+@dataclass(frozen=True)
+class ConfigTx:
+    """An epoch-based membership reconfiguration, signed by the fleet
+    admin key (node/config.py ``admin_public``). ``body`` is canonical
+    JSON (sorted keys, compact separators) describing the change:
+
+    * ``add``    — rows of {address, exchange_hex, sign_hex} to join
+    * ``remove`` — sign-key hexes to evict
+    * ``echo_threshold`` / ``ready_threshold`` — optional re-weighting
+    * ``grace``  — seconds old-epoch messages stay accepted
+
+    The admin signature covers (tag || epoch || body), so a transaction
+    can neither be replayed into a different epoch nor altered in
+    flight. Validation (epoch must be exactly current+1, signature must
+    verify against the configured admin key) lives in
+    node/membership.py — the wire layer only carries it."""
+
+    epoch: int
+    body: bytes  # canonical JSON change description
+    signature: bytes  # admin ed25519 over signing_bytes()
+
+    @staticmethod
+    def signing_bytes(epoch: int, body: bytes) -> bytes:
+        return _CONFIG_TAG + struct.pack("<Q", epoch) + body
+
+    def to_sign(self) -> bytes:
+        return self.signing_bytes(self.epoch, self.body)
+
+    @classmethod
+    def create(cls, admin_keypair, epoch: int, change: dict) -> "ConfigTx":
+        """Build and admin-sign a config transaction (the one
+        construction path tools, sims, and tests share)."""
+        body = json.dumps(
+            change, separators=(",", ":"), sort_keys=True
+        ).encode()
+        return cls(epoch, body, admin_keypair.sign(cls.signing_bytes(epoch, body)))
+
+    def change(self) -> dict:
+        return json.loads(self.body)
+
+    def encode(self) -> bytes:
+        return (
+            bytes([CONFIG_TX])
+            + _CONFIG_HDR.pack(self.epoch, len(self.body), self.signature)
+            + self.body
+        )
+
+    @staticmethod
+    def decode_body(body: bytes) -> "ConfigTx":
+        epoch, length, sig = _CONFIG_HDR.unpack_from(body)
+        payload = body[_CONFIG_HDR.size :]
+        if len(payload) != length:
+            raise WireError("config tx body length mismatch")
+        return ConfigTx(epoch, payload, sig)
+
+
 def parse_frame(frame: bytes) -> list:
     """Split a frame into messages (frames may coalesce many)."""
     out = []
@@ -660,6 +731,17 @@ def parse_frame(frame: bytes) -> list:
             out.append(
                 DirectoryAnnounce.decode_body(origin, bytes(view[DIR_HDR_WIRE:total]))
             )
+            view = view[total:]
+        elif kind == CONFIG_TX:
+            if len(view) < CONFIG_HDR_WIRE:
+                raise WireError("truncated config tx header")
+            _, length, _ = _CONFIG_HDR.unpack(bytes(view[1:CONFIG_HDR_WIRE]))
+            if length > MAX_CONFIG_BYTES:
+                raise WireError("config tx body too large")
+            total = CONFIG_HDR_WIRE + length
+            if len(view) < total:
+                raise WireError("truncated config tx body")
+            out.append(ConfigTx.decode_body(bytes(view[1:total])))
             view = view[total:]
         else:
             raise WireError(f"unknown message kind {kind}")

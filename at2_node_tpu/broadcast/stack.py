@@ -102,6 +102,7 @@ from .messages import (
     Attestation,
     BatchAttestation,
     BatchContentRequest,
+    ConfigTx,
     ContentRequest,
     DirectoryAnnounce,
     HistoryBatch,
@@ -477,6 +478,29 @@ class Broadcast:
         # (peer, msg) -> None; node/directory.py) — same routing shape as
         # the catchup plane; None drops them (a stack used standalone)
         self.directory_handler = None
+        # node-service hook for membership config transactions (sync
+        # callable (peer, msg) -> None; node/membership.py) — same shape
+        # as directory_handler; None drops them
+        self.config_handler = None
+        # sim hook fired whenever this node SIGNS an attestation (either
+        # plane): callable (phase, origin_or_sender, sequence, chash).
+        # The simulator's no-post-restart-equivocation invariant records
+        # every signing across a node's incarnations through this.
+        self.on_attest = None
+        # Broadcast-safety watermarks: the highest slot this node has
+        # attested per origin, per plane. Persisted in the store manifest
+        # and restored as FLOORS after a crash — _send_attestation /
+        # _send_batch_attestation refuse to sign any slot at or below the
+        # restored floor, so a restarted node can never sign a
+        # CONFLICTING echo/ready for a slot it attested pre-crash (the
+        # pre-crash vote may have reached peers even if nothing else
+        # survived locally). Liveness: refused slots commit through
+        # peers' quorums and reach this node via ledger catchup.
+        self._wm_tx: Dict[bytes, int] = {}  # client sender -> max seq
+        self._wm_batch: Dict[bytes, int] = {}  # batch origin -> max seq
+        self._floor_tx: Dict[bytes, int] = {}
+        self._floor_batch: Dict[bytes, int] = {}
+        self.floor_refusals = 0  # attestations suppressed by a floor
         # node-service hook fired (once per GC pass) when some slot has
         # been stalled past STALLED_CATCHUP_AFTER: push-retransmission
         # has failed, recovery belongs to the ledger-catchup plane.
@@ -917,6 +941,15 @@ class Broadcast:
                         self.directory_handler(peer, msg)
                     except Exception:
                         logger.exception("directory handler error")
+            elif isinstance(msg, ConfigTx):
+                # admin-signed membership transitions (node/membership.py);
+                # the handler validates the admin signature and epoch —
+                # peer may be None (admin-side local injection)
+                if self.config_handler is not None:
+                    try:
+                        self.config_handler(peer, msg)
+                    except Exception:
+                        logger.exception("config handler error")
             else:
                 if self._pre_attestation(msg, peer):
                     to_verify.append((msg.origin, msg.to_sign(), msg.signature))
@@ -1402,10 +1435,21 @@ class Broadcast:
     ) -> None:
         """Sign and send our batch Echo/Ready — broadcast by default,
         targeted when ``peer`` is given (straggler help)."""
+        floor = self._floor_batch.get(slot[0])
+        if floor is not None and slot[1] <= floor:
+            # same no-post-restart-equivocation discipline as the per-tx
+            # plane (_send_attestation); batch_seq is time-seeded per
+            # origin so fresh batches always clear a restored floor
+            self.floor_refusals += 1
+            return
+        if slot[1] > self._wm_batch.get(slot[0], 0):
+            self._wm_batch[slot[0]] = slot[1]
         bitmap = bits.to_bytes((nbits + 7) // 8, "little")
         sig = self.keypair.sign(
             BatchAttestation.signing_bytes(phase, slot[0], slot[1], chash, bitmap)
         )
+        if self.on_attest is not None:
+            self.on_attest(phase, slot[0], slot[1], chash)
         att = BatchAttestation(
             phase, self.keypair.public, slot[0], slot[1], chash, bitmap, sig
         )
@@ -1643,6 +1687,28 @@ class Broadcast:
         else:
             self.mesh.broadcast(frame)
 
+    # -- durability (store manifest round-trip, at2_node_tpu/store/) ------
+
+    def export_watermarks(self) -> dict:
+        """Per-origin max-attested slots, both planes — persisted in the
+        store manifest on every flush."""
+        return {
+            "tx": {k.hex(): v for k, v in self._wm_tx.items()},
+            "batch": {k.hex(): v for k, v in self._wm_batch.items()},
+        }
+
+    def restore_watermarks(self, doc: dict) -> None:
+        """Install pre-crash watermarks as signing floors (and re-seed
+        the live watermarks so the next flush persists at least them)."""
+        for hx, seq in (doc.get("tx") or {}).items():
+            key = bytes.fromhex(hx)
+            self._floor_tx[key] = int(seq)
+            self._wm_tx[key] = max(self._wm_tx.get(key, 0), int(seq))
+        for hx, seq in (doc.get("batch") or {}).items():
+            key = bytes.fromhex(hx)
+            self._floor_batch[key] = int(seq)
+            self._wm_batch[key] = max(self._wm_batch.get(key, 0), int(seq))
+
     # -- state transitions (synchronous; no awaits) -----------------------
 
     def _send_attestation(
@@ -1655,7 +1721,19 @@ class Broadcast:
     ) -> None:
         """Sign and send our Echo/Ready — broadcast by default, targeted
         when ``peer`` is given (straggler help)."""
+        floor = self._floor_tx.get(sender)
+        if floor is not None and sequence <= floor:
+            # no-post-restart-equivocation: this slot may hold a
+            # pre-crash vote from this node that peers already counted;
+            # signing again (possibly for different content) is the one
+            # thing a restarted node must never do
+            self.floor_refusals += 1
+            return
+        if sequence > self._wm_tx.get(sender, 0):
+            self._wm_tx[sender] = sequence
         sig = self.keypair.sign(Attestation.signing_bytes(phase, sender, sequence, chash))
+        if self.on_attest is not None:
+            self.on_attest(phase, sender, sequence, chash)
         att = Attestation(phase, self.keypair.public, sender, sequence, chash, sig)
         if self.recorder is not None:
             self.recorder.record(

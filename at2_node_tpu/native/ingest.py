@@ -29,6 +29,7 @@ from ..broadcast.messages import (
     BATCH_ECHO,
     BATCH_READY,
     BATCH_REQ,
+    CONFIG_TX,
     DIR_ANNOUNCE,
     ECHO,
     GOSSIP,
@@ -44,6 +45,7 @@ from ..broadcast.messages import (
     Attestation,
     BatchAttestation,
     BatchContentRequest,
+    ConfigTx,
     ContentRequest,
     DirectoryAnnounce,
     HistoryBatch,
@@ -217,7 +219,8 @@ def parse_frames_native(frames: Sequence[bytes]):
         elif kind == BATCH_REQ:
             msg = BatchContentRequest.decode_body(row_bytes[base + 1 : base + 73])
         elif kind in (
-            HIST_IDX, HIST_BATCH, BATCH, BATCH_ECHO, BATCH_READY, DIR_ANNOUNCE
+            HIST_IDX, HIST_BATCH, BATCH, BATCH_ECHO, BATCH_READY,
+            DIR_ANNOUNCE, CONFIG_TX,
         ):
             # variable-length rows carry (offset, length) into `flat`
             off = int.from_bytes(row_bytes[base + 1 : base + 9], "little")
@@ -227,6 +230,8 @@ def parse_frames_native(frames: Sequence[bytes]):
                 msg = TxBatch.decode_body(body)
             elif kind in (BATCH_ECHO, BATCH_READY):
                 msg = BatchAttestation.decode_body(kind, body)
+            elif kind == CONFIG_TX:
+                msg = ConfigTx.decode_body(body)
             elif kind == DIR_ANNOUNCE:
                 origin, _count = _DIR_HDR.unpack_from(body)
                 msg = DirectoryAnnounce.decode_body(origin, body[_DIR_HDR.size :])

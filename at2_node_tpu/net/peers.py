@@ -95,6 +95,9 @@ class Mesh:
         self._server: Optional[asyncio.base_events.Server] = None
         self._send_queues: Dict[bytes, asyncio.Queue] = {}
         self._tasks: list = []
+        # outbound loops keyed by exchange key so membership removal can
+        # cancel exactly one peer's dialer (node/membership.py)
+        self._outbound_tasks: Dict[bytes, asyncio.Task] = {}
         self._channels: set = set()  # live channels, closed on shutdown
         self._closed = False
         # native-reader inbound plane (net docstring in native/reader.py):
@@ -170,16 +173,23 @@ class Mesh:
                 self._handle_inbound, host or "0.0.0.0", int(port)
             )
         for peer in self.peers:
-            q: asyncio.Queue = asyncio.Queue(maxsize=SEND_QUEUE_CAP)
-            self._send_queues[peer.exchange_public] = q
-            self._tasks.append(asyncio.create_task(self._outbound_loop(peer, q)))
+            self._start_outbound(peer)
+
+    def _start_outbound(self, peer: Peer) -> None:
+        q: asyncio.Queue = asyncio.Queue(maxsize=SEND_QUEUE_CAP)
+        self._send_queues[peer.exchange_public] = q
+        self._outbound_tasks[peer.exchange_public] = asyncio.create_task(
+            self._outbound_loop(peer, q)
+        )
 
     async def close(self) -> None:
         self._closed = True
-        for t in self._tasks:
+        tasks = self._tasks + list(self._outbound_tasks.values())
+        for t in tasks:
             t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await asyncio.gather(*tasks, return_exceptions=True)
         self._tasks.clear()
+        self._outbound_tasks.clear()
         for channel in list(self._channels):
             channel.close()
         self._channels.clear()
@@ -192,6 +202,45 @@ class Mesh:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+
+    # -- membership (node/membership.py epoch transitions) -----------------
+
+    def add_peer(self, peer: Peer) -> bool:
+        """Register a peer joining the mesh (epoch reconfiguration). If
+        the mesh is already running, its outbound dialer starts
+        immediately; inbound connections authenticate as soon as the key
+        is registered. Returns False for self or an already-known key."""
+        if (
+            peer.exchange_public == self.keypair.public
+            or peer.exchange_public in self.by_exchange
+        ):
+            return False
+        self.peers.append(peer)
+        self.by_exchange[peer.exchange_public] = peer
+        self.by_sign[peer.sign_public] = peer
+        if self._loop is not None and not self._closed:
+            self._start_outbound(peer)
+        return True
+
+    def remove_peer(self, sign_public: bytes) -> bool:
+        """Evict a peer (epoch reconfiguration): cancel its outbound
+        dialer, drop its queue, and forget its keys — NEW inbound
+        handshakes from it are rejected like any unknown key. Channels
+        it already holds drain until they close (the epoch grace window;
+        stack-level epoch checks reject its stale messages meanwhile)."""
+        peer = self.by_sign.pop(sign_public, None)
+        if peer is None:
+            return False
+        self.by_exchange.pop(peer.exchange_public, None)
+        self.peers = [
+            p for p in self.peers
+            if p.exchange_public != peer.exchange_public
+        ]
+        self._send_queues.pop(peer.exchange_public, None)
+        task = self._outbound_tasks.pop(peer.exchange_public, None)
+        if task is not None:
+            task.cancel()
+        return True
 
     # -- sending ----------------------------------------------------------
 

@@ -143,6 +143,54 @@ class CheckpointConfig:
 
 
 @dataclass
+class StoreConfig:
+    """Durable sharded store (at2_node_tpu/store/): per-account-range
+    segment files + a write-ahead delta log of committed slots, committed
+    atomically by a manifest rename. Supersedes the monolithic
+    ``[checkpoint]`` snapshot (still honored: when only ``[checkpoint]``
+    is configured the old path runs unchanged, and when BOTH are set an
+    existing monolithic snapshot seeds an uninitialized store — the
+    one-shot migration). ``flush_interval`` is the seconds between
+    incremental flushes (dirty shards only); ``shards`` fixes the
+    account-range partition width at store creation; ``sync`` is the WAL
+    append discipline (``"buffered"`` = durable at next flush,
+    ``"always"`` = fsync per commit); ``history_cap`` bounds retained
+    per-sender history bodies (mirrors catchup.history_cap)."""
+
+    dir: str = ""  # store directory; "" disables the sharded store
+    flush_interval: float = 5.0  # seconds between incremental flushes
+    shards: int = 16
+    sync: str = "buffered"  # "buffered" | "always"
+    history_cap: int = 1 << 17
+
+    def __post_init__(self) -> None:
+        if self.sync not in ("buffered", "always"):
+            raise ValueError('store.sync must be "buffered" or "always"')
+        if self.shards < 1:
+            raise ValueError("store.shards must be >= 1")
+        if self.flush_interval <= 0:
+            raise ValueError("store.flush_interval must be > 0")
+
+
+@dataclass
+class MembershipConfig:
+    """Epoch-based membership reconfiguration (node/membership.py).
+    ``admin_public`` is the hex ed25519 key every CONFIG_TX must verify
+    against; "" disables reconfiguration entirely (config transactions
+    are dropped). ``grace`` is the window, in seconds after an epoch
+    transition, during which messages stamped with the PREVIOUS epoch
+    are still accepted — covers transactions already in flight when the
+    transition lands."""
+
+    admin_public: str = ""  # hex ed25519 admin key; "" disables
+    grace: float = 5.0
+
+    def __post_init__(self) -> None:
+        if self.grace < 0:
+            raise ValueError("membership.grace must be >= 0")
+
+
+@dataclass
 class CatchupConfig:
     """Ledger-history catchup (ledger/history.py): a rejoining node pulls
     quorum-confirmed committed history from peers and replays it through
@@ -251,6 +299,8 @@ class Config:
     )
     slo: SloConfig = field(default_factory=SloConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
+    membership: MembershipConfig = field(default_factory=MembershipConfig)
     catchup: CatchupConfig = field(default_factory=CatchupConfig)
     batching: BatchingConfig = field(default_factory=BatchingConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
@@ -314,6 +364,25 @@ class Config:
                 f'path = "{self.checkpoint.path}"',
                 f"interval = {self.checkpoint.interval}",
             ]
+        st = self.store
+        if st != StoreConfig():
+            lines += [
+                "",
+                "[store]",
+                f'dir = "{st.dir}"',
+                f"flush_interval = {st.flush_interval}",
+                f"shards = {st.shards}",
+                f'sync = "{st.sync}"',
+                f"history_cap = {st.history_cap}",
+            ]
+        mb = self.membership
+        if mb != MembershipConfig():
+            lines += [
+                "",
+                "[membership]",
+                f'admin_public = "{mb.admin_public}"',
+                f"grace = {mb.grace}",
+            ]
         cu = self.catchup
         if cu != CatchupConfig():
             lines += [
@@ -360,6 +429,8 @@ class Config:
         observability = ObservabilityConfig(**doc.get("observability", {}))
         slo = SloConfig(**doc.get("slo", {}))
         ckpt = CheckpointConfig(**doc.get("checkpoint", {}))
+        store = StoreConfig(**doc.get("store", {}))
+        membership = MembershipConfig(**doc.get("membership", {}))
         catchup = CatchupConfig(**doc.get("catchup", {}))
         batching = BatchingConfig(**doc.get("batching", {}))
         admission = AdmissionConfig(**doc.get("admission", {}))
@@ -380,6 +451,8 @@ class Config:
             observability=observability,
             slo=slo,
             checkpoint=ckpt,
+            store=store,
+            membership=membership,
             catchup=catchup,
             batching=batching,
             admission=admission,

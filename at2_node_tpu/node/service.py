@@ -64,8 +64,10 @@ from ..types import (
     TransactionState,
     rfc3339,
 )
+from ..store import RecoveryProgress, ShardedStore
 from .config import Config
 from .directory import ClientDirectory, DirectoryFullError
+from .membership import MembershipManager
 
 logger = logging.getLogger(__name__)
 
@@ -222,6 +224,19 @@ class Service(At2Servicer):
             "slo_samples", "probe samples held by the SLO engine",
             fn=lambda: self.slo.sample_count,
         )
+        # durable sharded store (store/sharded.py): None when [store] dir
+        # is unset — the node then falls back to the legacy monolithic
+        # checkpoint (ledger/checkpoint.py), exactly as before
+        self.store: Optional[ShardedStore] = None
+        self._store_task: Optional[asyncio.Task] = None
+        # recovery state machine (store/recovery.py): starts "cold"; a
+        # store-backed restart walks loading_segments -> replaying_wal ->
+        # catchup -> live and /healthz reports "recovering" on the way
+        self.recovery = RecoveryProgress()
+        # epoch-based membership (node/membership.py): None when no
+        # [membership] admin key is configured
+        self.membership: Optional[MembershipManager] = None
+        self._membership_task: Optional[asyncio.Task] = None
         self.verifier: Optional[Verifier] = None
         self.mesh: Optional[Mesh] = None
         self.broadcast: Optional[Broadcast] = None
@@ -345,6 +360,16 @@ class Service(At2Servicer):
             "rpc_",
             lambda: self._mux.stats() if self._mux is not None else {},
         )
+        self.registry.register_provider("store_", self._store_stats_view)
+        self.registry.register_provider(
+            "membership_",
+            lambda: (
+                self.membership.stats() if self.membership is not None else {}
+            ),
+        )
+        self.store_stats = self.registry.counter_group(
+            ("store_flushes", "store_segments_written", "store_segment_bytes")
+        )
 
     # -- lifecycle --------------------------------------------------------
 
@@ -385,18 +410,12 @@ class Service(At2Servicer):
                 raise
         # Resume ledger state BEFORE joining the network: peers judge this
         # node by its per-account sequence answers from the first message.
-        if config.checkpoint.path:
-            try:
-                await ckpt.load(
-                    config.checkpoint.path,
-                    service.accounts,
-                    service.recent,
-                    service.directory,
-                )
-            except Exception:
-                if service._owns_verifier:
-                    await service.verifier.close()
-                raise
+        try:
+            await service._restore_state()
+        except Exception:
+            if service._owns_verifier:
+                await service.verifier.close()
+            raise
         # Everything past the verifier is brought up under one guard:
         # close() tolerates partially-initialized state, so ANY bring-up
         # failure (mesh bind, broadcast start, profiler, grpc/mux bind)
@@ -436,6 +455,24 @@ class Service(At2Servicer):
                 service.verifier.recorder = service.recorder
             service.broadcast.catchup_handler = service._on_catchup
             service.broadcast.directory_handler = service._on_directory
+            if service.store is not None:
+                # broadcast-safety floors: the slots this node attested
+                # before the crash are fenced — a restarted node never
+                # signs a conflicting echo/ready for them
+                service.broadcast.restore_watermarks(service.store.watermarks)
+            mcfg = config.membership
+            if mcfg.admin_public:
+                service.membership = MembershipManager(
+                    admin_public=bytes.fromhex(mcfg.admin_public),
+                    clock=service.clock,
+                    grace=mcfg.grace,
+                    epoch=service.store.epoch if service.store else 0,
+                    mesh=service.mesh,
+                    on_thresholds=service._on_thresholds,
+                    own_sign_public=config.sign_key.public,
+                )
+                service.recovery.epoch = service.membership.epoch
+                service.broadcast.config_handler = service._on_config_tx
             if config.catchup.enabled:
                 # broadcast GC signal: a slot stalled past push-
                 # retransmission recovers via the ledger-catchup plane
@@ -454,10 +491,38 @@ class Service(At2Servicer):
                 service._catchup_task = asyncio.create_task(
                     service._catchup_runner(initial_delay=config.catchup.after)
                 )
+            if service.recovery.state == "catchup" and not (
+                config.catchup.enabled and service.mesh.peers
+            ):
+                # nothing to catch up FROM: a peerless (or catchup-
+                # disabled) store restart is as live as it will ever be
+                service.recovery.mark_live(service.clock.monotonic())
+
+            # incremental store flush loop (config [store] flush_interval).
+            # Like the SLO probe, only on SERVED nodes: the sim flushes
+            # and sweeps explicitly at deterministic points instead.
+            if (
+                serve_rpc
+                and service.store is not None
+                and config.store.flush_interval > 0
+            ):
+                service._store_task = asyncio.create_task(
+                    service._store_flush_loop(config.store.flush_interval)
+                )
+            if serve_rpc and service.membership is not None:
+                service._membership_task = asyncio.create_task(
+                    service._membership_loop()
+                )
 
             # interval <= 0 means snapshot-on-shutdown only (consistent with
-            # the observability convention where 0 disables the periodic task)
-            if config.checkpoint.path and config.checkpoint.interval > 0:
+            # the observability convention where 0 disables the periodic
+            # task). The legacy monolithic loop is superseded entirely by
+            # the sharded store when [store] dir is configured.
+            if (
+                service.store is None
+                and config.checkpoint.path
+                and config.checkpoint.interval > 0
+            ):
                 service._checkpoint_task = asyncio.create_task(
                     service._checkpoint_loop(
                         config.checkpoint.path, config.checkpoint.interval
@@ -551,6 +616,18 @@ class Service(At2Servicer):
                 await self._checkpoint_task
             except asyncio.CancelledError:
                 pass
+        if self._store_task is not None:
+            self._store_task.cancel()
+            try:
+                await self._store_task
+            except asyncio.CancelledError:
+                pass
+        if self._membership_task is not None:
+            self._membership_task.cancel()
+            try:
+                await self._membership_task
+            except asyncio.CancelledError:
+                pass
         if self._mux is not None:
             await self._mux.close()
         if self._grpc_server is not None:
@@ -602,7 +679,13 @@ class Service(At2Servicer):
             await self._drain_to_fixpoint()
         # Final snapshot LAST — ingress, delivery, and broadcast are all
         # stopped, so no commit can land after (and be missing from) it.
-        if self.config.checkpoint.path:
+        if self.store is not None:
+            try:
+                await self._store_flush()
+            except OSError:
+                logger.exception("final store flush failed")
+            self.store.close()
+        elif self.config.checkpoint.path:
             try:
                 await ckpt.save(
                     self.config.checkpoint.path,
@@ -622,6 +705,184 @@ class Service(At2Servicer):
                 await ckpt.save(path, self.accounts, self.recent, self.directory)
             except OSError:
                 logger.exception("periodic checkpoint failed")
+
+    # -- durable sharded store (store/) ----------------------------------
+
+    async def _restore_state(self) -> None:
+        """Resume ledger state at start. With [store] dir configured this
+        opens (or initializes) the sharded store — migrating a legacy
+        monolithic checkpoint one-shot if one exists and the store does
+        not yet — and walks the recovery machine through
+        loading_segments/replaying_wal; without it, the legacy full-
+        snapshot path loads exactly as before."""
+        scfg = self.config.store
+        ccfg = self.config.checkpoint
+        if not scfg.dir:
+            if ccfg.path:
+                await ckpt.load(
+                    ccfg.path, self.accounts, self.recent, self.directory
+                )
+            return
+        self.recovery.started_at = self.clock.monotonic()
+        legacy = None
+        if ccfg.path:
+            # parsed, not loaded: the store decides whether to migrate
+            # (only when no manifest exists yet)
+            try:
+                with open(ccfg.path) as fp:
+                    legacy = json.load(fp)
+            except FileNotFoundError:
+                legacy = None
+
+        def _on_segment(loaded: int, total: int) -> None:
+            self.recovery.advance("loading_segments")
+            self.recovery.segments_loaded = loaded
+            self.recovery.segments_total = total
+
+        def _on_wal_record(count: int) -> None:
+            self.recovery.advance("replaying_wal")
+            self.recovery.wal_records_replayed = count
+
+        self.recovery.advance("loading_segments")
+        store = ShardedStore.open(
+            scfg.dir,
+            n_shards=scfg.shards,
+            sync=scfg.sync,
+            history_cap=scfg.history_cap,
+            legacy_checkpoint=legacy,
+            on_segment=_on_segment,
+            on_wal_record=_on_wal_record,
+        )
+        self.store = store
+        self.recovery.segments_total = max(
+            self.recovery.segments_total, store.segments_loaded
+        )
+        self.recovery.wal_records_replayed = store.wal_replayed
+        self.recovery.migrated = store.migrated
+        self.recovery.epoch = store.epoch
+        await self.accounts.import_state(store.accounts_state())
+        await self.recent.import_state(store.recent_rows)
+        self.directory.import_(store.directory_rows)
+        # refill the catchup serving store from persisted history so a
+        # restarted node can serve peers (and the conservation invariant
+        # can replay) without waiting for new commits
+        for payload in store.iter_history():
+            self.history.record(payload)
+        # the distilled-batch dedup window survives restart (a replaying
+        # broker must not get a second pass at the broadcast plane just
+        # because this node bounced)
+        for row in store.distill_seen:
+            self._distill_seen[(int(row[0]), int(row[1]))] = None
+        # re-enqueue delivered-but-uncommitted payloads at the sequence
+        # gate: the broadcast never retransmits a delivered slot, and
+        # catchup can only confirm it while `quorum` full-history peers
+        # are alive — the parked set is this node's own durable copy.
+        # Fresh `now`: a restart grants the slot a new TTL window.
+        now = self.clock.monotonic()
+        restored_parked = 0
+        for payload in store.iter_parked():
+            if self._push_pending(payload, now):
+                restored_parked += 1
+        self.recovery.advance("catchup")
+        logger.info(
+            "store restored: gen=%d %d accounts, %d segments, "
+            "%d wal records, %d parked%s",
+            store.gen,
+            store.account_count(),
+            store.segments_loaded,
+            store.wal_replayed,
+            restored_parked,
+            " (migrated legacy checkpoint)" if store.migrated else "",
+        )
+
+    def _store_stats_view(self) -> dict:
+        if self.store is None:
+            return {}
+        return {
+            "gen": self.store.gen,
+            "accounts": self.store.account_count(),
+            "history": self.store.history_count(),
+            "parked": self.store.parked_count(),
+        }
+
+    async def _store_flush(self) -> None:
+        """One incremental flush: refresh the manifest's small state
+        (directory, recent ring, broadcast-safety watermarks, dedup
+        window, epoch), then write dirty shards + rotate the WAL.
+        Synchronous on the event loop by design — the mirror the flush
+        walks is mutated by the commit path on this same loop, so
+        off-thread flushing would race it; cost is bounded by the delta
+        since the last flush (BENCH_DURABILITY.json)."""
+        if self.store is None:
+            return
+        watermarks = (
+            self.broadcast.export_watermarks()
+            if self.broadcast is not None
+            else None
+        )
+        seen = list(self._distill_seen)[-4096:]
+        self.store.set_meta(
+            directory_rows=self.directory.export(),
+            recent_rows=await self.recent.export_state(),
+            watermarks=watermarks,
+            distill_seen=[[cid, seq] for cid, seq in seen],
+            epoch=self.membership.epoch if self.membership else None,
+        )
+        stats = self.store.flush()
+        if stats:
+            self.store_stats["store_flushes"] += 1
+            self.store_stats["store_segments_written"] += stats[
+                "segments_written"
+            ]
+            self.store_stats["store_segment_bytes"] += stats["segment_bytes"]
+
+    async def _store_flush_loop(self, interval: float) -> None:
+        while True:
+            await self.clock.sleep(interval)
+            try:
+                await self._store_flush()
+            except OSError:
+                logger.exception("store flush failed")
+
+    # -- membership reconfiguration (node/membership.py) ------------------
+
+    async def _membership_loop(self) -> None:
+        """Finalize expired eviction grace windows (mesh removal + ban)."""
+        while True:
+            await self.clock.sleep(1.0)
+            try:
+                self.membership.sweep()
+            except Exception:
+                logger.exception("membership sweep failed")
+
+    def _on_thresholds(
+        self, echo: Optional[int], ready: Optional[int]
+    ) -> None:
+        """Quorum re-weighting hook: a ConfigTx naming new thresholds
+        re-weights the broadcast stack's echo/ready quorums in place."""
+        if self.broadcast is None:
+            return
+        if echo is not None:
+            self.broadcast.echo_threshold = echo
+        if ready is not None:
+            self.broadcast.ready_threshold = ready
+
+    def _on_config_tx(self, peer, tx) -> None:
+        """Broadcast-worker hook (synchronous): validate/apply a gossiped
+        ConfigTx. ``peer`` is None for admin-local injection. A NEWLY
+        applied transition is re-gossiped so the fleet converges
+        regardless of arrival topology, and the epoch is persisted so a
+        restart rejoins at the epoch it had reached."""
+        if self.membership is None:
+            return
+        if not self.membership.handle(tx):
+            return
+        self.recovery.epoch = self.membership.epoch
+        if self.store is not None:
+            self.store.set_meta(epoch=self.membership.epoch)
+        self.recorder.record("config_apply", (self.membership.epoch,))
+        if self.mesh is not None and self.mesh.peers:
+            self.mesh.broadcast(tx.encode())
 
     # -- observability ---------------------------------------------------
 
@@ -806,11 +1067,16 @@ class Service(At2Servicer):
             and not slo_breach
             and not self._closing
         )
+        # a store-backed restart reports "recovering" until catchup lag
+        # hits zero: healthy-but-behind, distinct from degraded (top.py
+        # tolerates it within its deadline; probes still get 503 — the
+        # node is not a full quorum participant yet)
+        recovering = self.recovery.recovering
         # anomaly-triggered capture: the moment health flips ok->degraded
-        # (for a real reason, not shutdown), freeze the flight recorder so
-        # the lead-up survives ring rollover. Edge-triggered on the
-        # transition, so a poll loop hammering a degraded node takes ONE
-        # snapshot per incident, not one per scrape.
+        # (for a real reason, not shutdown or recovery), freeze the flight
+        # recorder so the lead-up survives ring rollover. Edge-triggered
+        # on the transition, so a poll loop hammering a degraded node
+        # takes ONE snapshot per incident, not one per scrape.
         if not ok and self._health_was_ok and not self._closing:
             if stalled:
                 reason = "stalled"
@@ -820,8 +1086,16 @@ class Service(At2Servicer):
                 reason = "slo:" + ",".join(slo_breach)
             self.recorder.snapshot("healthz_degraded:" + reason)
         self._health_was_ok = ok
+        if not ok:
+            status = "degraded"
+        elif recovering:
+            status = "recovering"
+        else:
+            status = "ok"
         return {
-            "status": "ok" if ok else "degraded",
+            "status": status,
+            "recovering": recovering,
+            "epoch": self.membership.epoch if self.membership else 0,
             "closing": self._closing,
             "peers_configured": peers_total,
             "peers_connected": channels,
@@ -849,6 +1123,10 @@ class Service(At2Servicer):
             "tx_lifecycle": self.tx_trace.snapshot(),
             "verifier_stages": stages,
             "slo": self.slo.evaluate(),
+            "recovery": self.recovery.to_dict(self.clock.monotonic()),
+            "membership": (
+                self.membership.stats() if self.membership else {}
+            ),
         }
 
     # -- delivery → commit loop ------------------------------------------
@@ -875,6 +1153,15 @@ class Service(At2Servicer):
         self._heap_keys.add(key)
         self._push_count += 1
         heapq.heappush(self._heap, (key, now, self._push_count, p))
+        if self.store is not None:
+            # a delivered payload is never retransmitted by the
+            # broadcast: losing the heap at a crash would strand slots
+            # whose full-history copies dip below the catchup quorum, so
+            # park it durably until it commits or times out
+            try:
+                self.store.note_parked(p)
+            except OSError:
+                logger.exception("store parked append failed")
         return True
 
     async def _delivery_loop(self) -> None:
@@ -928,6 +1215,7 @@ class Service(At2Servicer):
                 retry: List[tuple] = []
                 ring_ops: List[tuple] = []
                 commits: List[tuple] = []
+                drops: List[Payload] = []  # gave up: unpark from the store
                 for key, added, tiebreak, payload in batch:
                     # An already-consumed sequence can never commit (the
                     # gate admits exactly last+1 and last only grows);
@@ -956,6 +1244,7 @@ class Service(At2Servicer):
                                     payload.sequence,
                                 )
                             )
+                            drops.append(payload)
                             continue
                         if key not in catchup_keys:
                             # catchup-sourced payloads are quorum-
@@ -992,6 +1281,7 @@ class Service(At2Servicer):
                         continue
                     except Exception as exc:
                         logger.warning("dropping bad payload: %s", exc)
+                        drops.append(payload)
                         continue
                     ring_ops.append(
                         (
@@ -1001,12 +1291,29 @@ class Service(At2Servicer):
                             TransactionState.SUCCESS,
                         )
                     )
-                    commits.append((key, payload))
-                return retry, ring_ops, commits
+                    # POST-commit balances captured here, inside the
+                    # exclusive section, so the WAL record the store
+                    # appends is exactly the ledger state this transfer
+                    # left behind (a later read could see a newer value)
+                    s_bal = accounts._ledger[payload.sender].balance
+                    recipient = payload.transaction.recipient
+                    r_bal = (
+                        accounts._ledger[recipient].balance
+                        if recipient != payload.sender
+                        else None
+                    )
+                    commits.append((key, payload, s_bal, r_bal))
+                return retry, ring_ops, commits, drops
 
-            retry, ring_ops, commits = await self.accounts.run_exclusive(
+            retry, ring_ops, commits, drops = await self.accounts.run_exclusive(
                 _apply_pass
             )
+            if drops and self.store is not None:
+                for p in drops:
+                    try:
+                        self.store.note_unparked(p)
+                    except OSError:
+                        logger.exception("store unpark append failed")
             if commits or ring_ops:
                 # the accounts mutation already happened inside
                 # run_exclusive: a cancellation landing between it and the
@@ -1050,13 +1357,23 @@ class Service(At2Servicer):
         """Post-apply commit bookkeeping, always run to completion (the
         caller shields it): history retention, counters, equivocation-
         registry release, and the recent-ring flips."""
-        for key, payload in commits:
+        for key, payload, s_bal, r_bal in commits:
             logger.info(
                 "new payload: seq=%d sender=%s",
                 payload.sequence,
                 payload.sender.hex()[:16],
             )
             self.committed += 1
+            if self.store is not None:
+                # WAL append first (durability), then the in-memory fold;
+                # a store I/O failure must not split a commit from its
+                # ring/history bookkeeping
+                try:
+                    self.store.note_commit(
+                        payload, payload.sequence, s_bal, r_bal
+                    )
+                except OSError:
+                    logger.exception("store wal append failed")
             self.tx_trace.stamp(
                 (payload.sender, payload.sequence), "committed"
             )
@@ -1247,6 +1564,8 @@ class Service(At2Servicer):
                 if applied == 0 and not gap_remains and (
                     responses > 0 or attempts >= self._CATCHUP_MIN_ATTEMPTS
                 ):
+                    if self.recovery.state == "catchup":
+                        self.recovery.mark_live(now)
                     return
                 if applied == 0 and gap_remains:
                     logger.log(
@@ -1279,6 +1598,7 @@ class Service(At2Servicer):
         session = _CatchupSession(self._nonce_bits(64), len(peers))
         self._catchup_session = session
         self.catchup_stats["catchup_sessions"] += 1
+        self.recovery.catchup_sessions += 1
         try:
             self.mesh.broadcast(HistoryIndexRequest(session.nonce).encode())
             await self.clock.sleep(cfg.window)
@@ -1289,6 +1609,16 @@ class Service(At2Servicer):
                 for sender, seq in frontier:
                     if seq > local.get(sender, 0) and seq > needed.get(sender, 0):
                         needed[sender] = seq
+            # catchup lag = missing slots vs the fleet frontier; a session
+            # where peers answered and reported nothing missing is the
+            # recovery machine's "caught up to live" signal
+            if self.recovery.state == "catchup":
+                self.recovery.catchup_lag = sum(
+                    top - local.get(sender, 0)
+                    for sender, top in needed.items()
+                )
+                if responses > 0 and not needed:
+                    self.recovery.mark_live(self.clock.monotonic())
             if not needed:
                 return responses, 0
             for sender, top in needed.items():

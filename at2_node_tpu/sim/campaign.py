@@ -141,6 +141,102 @@ def generate_events(
     return events
 
 
+def generate_durability_events(
+    rng: random.Random,
+    *,
+    nodes: int = 4,
+    n_clients: int = 4,
+    n_events: int = 30,
+    duration: float = 24.0,
+    hostile: bool = True,
+    faults: bool = True,
+) -> List[Event]:
+    """A durability schedule: honest traffic with crash/restart cycles
+    woven through it — a victim node is killed mid-load (optionally
+    after a store flush, so restart covers both the segments+WAL-tail
+    and the pure-WAL recovery paths), rebooted later, and sometimes
+    partitioned from a peer right as its catchup starts. With
+    ``hostile`` a membership reconfiguration races the in-flight slots:
+    the fleet admin evicts the byzantine identity and re-weights the
+    quorum thresholds, and every node flushes right after so the new
+    epoch is durable across any later crash."""
+    events: List[Event] = []
+    next_seq = [1] * n_clients
+    for _ in range(n_events):
+        t = round(rng.uniform(0.0, duration), 3)
+        c = rng.randrange(n_clients)
+        events.append(
+            [
+                t,
+                "tx",
+                {
+                    "node": rng.randrange(nodes),
+                    "client": c,
+                    "seq": next_seq[c],
+                    "to": rng.randrange(n_clients),
+                    "amount": rng.randint(1, 50),
+                },
+            ]
+        )
+        next_seq[c] += 1
+    # crash/restart cycles on distinct victims, in DISJOINT downtime
+    # windows: the schedule must respect the f-budget. Two nodes down at
+    # once (f=1) leaves slots committed during the overlap with fewer
+    # live copies than the catchup vote quorum (ready_threshold), which
+    # correctly stalls recovery forever — a schedule bug, not a finding.
+    n_cycles = rng.randint(1, 2) if nodes > 2 else 1
+    victims = rng.sample(range(nodes), n_cycles)
+    span = (duration * 0.7) / n_cycles
+    for k, v in enumerate(victims):
+        w0 = duration * 0.2 + k * span
+        t_kill = round(w0 + rng.uniform(0.0, span * 0.25), 3)
+        t_boot = round(t_kill + rng.uniform(1.5, max(1.6, span * 0.45)), 3)
+        if rng.random() < 0.7:
+            # flush first: restart sees segments + a WAL tail, not just
+            # a WAL (the "restart from stale checkpoint" case when more
+            # traffic lands between flush and kill)
+            events.append(
+                [
+                    round(max(0.0, t_kill - rng.uniform(0.5, 3.0)), 3),
+                    "flush",
+                    {"node": v},
+                ]
+            )
+        events.append([t_kill, "kill", {"node": v}])
+        events.append([t_boot, "boot", {"node": v}])
+        if faults and rng.random() < 0.5 and nodes >= 2:
+            # partition the rebooting node from one peer while its
+            # catchup runs (it must still confirm via the others)
+            other = rng.choice([x for x in range(nodes) if x != v])
+            events.append(
+                [
+                    round(t_boot + 0.1, 3),
+                    "cut",
+                    {
+                        "a": v,
+                        "b": other,
+                        "duration": round(rng.uniform(1.0, 5.0), 3),
+                    },
+                ]
+            )
+    if hostile and rng.random() < 0.6:
+        # reconfiguration racing in-flight slots: evict the hostile
+        # identity, tighten nothing (thresholds re-derived for the
+        # smaller peer set), then persist the epoch everywhere
+        t = round(rng.uniform(duration * 0.1, duration * 0.8), 3)
+        events.append(
+            [
+                t,
+                "reconfig",
+                {"node": rng.randrange(nodes), "change": {"remove_hostile": True}},
+            ]
+        )
+        for i in range(nodes):
+            events.append([round(t + 1.0, 3), "flush", {"node": i}])
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
 BROKER_MUTATIONS = ("none", "dup", "reorder", "garbage", "withhold", "reseq")
 
 
@@ -325,13 +421,29 @@ def apply_events(
     def node_sign(i: int) -> bytes:
         return net.configs[i].sign_key.public
 
+    def _track(task) -> None:
+        net.fabric._tasks.add(task)
+        task.add_done_callback(net.fabric._tasks.discard)
+
+    def _live(node: int) -> Optional[int]:
+        """The node itself, or deterministically the next live one when
+        it is crashed (durability schedules keep traffic flowing)."""
+        total = len(net.services)
+        for k in range(total):
+            cand = (node + k) % total
+            if cand not in net.down:
+                return cand
+        return None
+
     def submit(node, client_i, seq, to_i, amount):
+        node = _live(node)
+        if node is None:
+            return
         client = clients[client_i]
         task = loop.create_task(
             net.asubmit(node, client, seq, clients[to_i].public, amount)
         )
-        net.fabric._tasks.add(task)
-        task.add_done_callback(net.fabric._tasks.discard)
+        _track(task)
 
     # client index -> directory id, filled by "breg" events (first
     # successful registration wins; later "bsub" events read it)
@@ -481,6 +593,55 @@ def apply_events(
             loop.call_later(t, breg, args)
         elif kind == "bsub":
             loop.call_later(t, bsub, args)
+        elif kind == "kill":
+
+            def kill(args=args):
+                if args["node"] not in net.down:
+                    _track(loop.create_task(net._acrash(args["node"])))
+
+            loop.call_later(t, kill)
+        elif kind == "boot":
+
+            def boot(args=args):
+                if args["node"] in net.down:
+                    _track(loop.create_task(net.arestart(args["node"])))
+
+            loop.call_later(t, boot)
+        elif kind == "flush":
+
+            def flush(args=args):
+                if args["node"] in net.down:
+                    return
+                svc = net.services[args["node"]]
+                if svc.store is not None:
+                    _track(loop.create_task(svc._store_flush()))
+
+            loop.call_later(t, flush)
+        elif kind == "reconfig":
+
+            def reconfig(args=args):
+                node = _live(args["node"])
+                if node is None:
+                    return
+                change = dict(args["change"])
+                if change.pop("remove_hostile", None):
+                    removes = [
+                        c.sign_key.public.hex() for c in net.hostile_configs
+                    ]
+                    change["remove"] = list(change.get("remove", [])) + removes
+                    # re-derive the crash-fault quorum for the smaller
+                    # peer set (the byzantine margin is no longer needed)
+                    n_peers = len(net.peers) - 1 - len(removes)
+                    thr = max(1, n_peers - net.f)
+                    change.setdefault("echo_threshold", thr)
+                    change.setdefault("ready_threshold", thr)
+                _track(
+                    loop.create_task(
+                        net.areconfig(node, change, epoch=args.get("epoch"))
+                    )
+                )
+
+            loop.call_later(t, reconfig)
         elif kind == "drop":
 
             def drop(args=args):
@@ -543,6 +704,7 @@ def run_episode(
     config_overrides: Optional[dict] = None,
     capture_obs: Optional[bool] = None,
     broker: bool = False,
+    durability: bool = False,
 ) -> EpisodeResult:
     """One self-contained episode: fresh SimNet, (generated or given)
     events, run + settle, invariant check, teardown. Pure in
@@ -556,9 +718,18 @@ def run_episode(
     ``broker``: generate a byzantine-broker schedule (ingress via
     distilled frames with broker mutations) instead of the per-tx one,
     and additionally sweep every committed payload for a valid client
-    signature (:func:`_forged_commit_sweep`)."""
+    signature (:func:`_forged_commit_sweep`).
+
+    ``durability``: run every node on a durable sharded store with
+    membership armed, and generate a crash/restart/reconfig schedule
+    (:func:`generate_durability_events`). The invariant sweep then also
+    covers no-post-restart-equivocation (recorded live by the net)."""
     wall0 = time.monotonic()
     rng = random.Random(_seed_int("episode", seed))
+    sim_kwargs = dict(config_overrides or {})
+    if durability:
+        sim_kwargs.setdefault("durable", True)
+        sim_kwargs.setdefault("membership_grace", 1.0)
     net = SimNet(
         nodes,
         f,
@@ -567,12 +738,17 @@ def run_episode(
         link=link,
         echo_threshold=echo_threshold,
         ready_threshold=ready_threshold,
-        **(config_overrides or {}),
+        **sim_kwargs,
     ).start()
     try:
         clients = [sim_client(seed, i) for i in range(n_clients)]
         if events is None:
-            generate = generate_broker_events if broker else generate_events
+            if durability:
+                generate = generate_durability_events
+            elif broker:
+                generate = generate_broker_events
+            else:
+                generate = generate_events
             events = generate(
                 rng,
                 nodes=nodes,
@@ -597,6 +773,12 @@ def run_episode(
         violations = net.check_invariants()
         if broker:
             violations += _forged_commit_sweep(net)
+        if durability and net.down:
+            # a schedule must always reboot what it kills; a node still
+            # down at quiescence is a schedule bug, not a safety pass
+            violations.append(
+                f"durability schedule left nodes down: {sorted(net.down)}"
+            )
         obs = None
         if capture_obs or (capture_obs is None and violations):
             obs = _capture_obs(net)
@@ -740,13 +922,16 @@ def run_campaign(
     link: Optional[LinkModel] = None,
     progress: Optional[Callable[[int, "EpisodeResult"], None]] = None,
     broker: bool = False,
+    durability: bool = False,
 ) -> dict:
     """``episodes`` independent seeded episodes; per-episode seeds derive
     from the campaign seed, failures carry their exact replay recipe
     (seed + event list), and the campaign hash — sha256 over the
     episode trace hashes — is the determinism fingerprint CI compares
     across two same-seed runs. ``broker=True`` runs the byzantine-broker
-    flavor of every episode (distilled ingress + forged-commit sweep)."""
+    flavor of every episode (distilled ingress + forged-commit sweep);
+    ``durability=True`` the crash/restart/reconfig flavor (durable
+    stores + membership + no-post-restart-equivocation)."""
     camp_rng = random.Random(_seed_int("campaign", seed))
     results: List[EpisodeResult] = []
     for ep in range(episodes):
@@ -760,6 +945,7 @@ def run_campaign(
             duration=duration,
             link=link,
             broker=broker,
+            durability=durability,
         )
         if result.violations and minimize:
             result.minimized = minimize_events(
@@ -774,6 +960,7 @@ def run_campaign(
                         link=link,
                         capture_obs=False,
                         broker=broker,
+                        durability=durability,
                     ).violations
                 ),
             )
@@ -790,6 +977,7 @@ def run_campaign(
         "f": f,
         "hostile": hostile,
         "broker": broker,
+        "durability": durability,
         "campaign_hash": h.hexdigest(),
         "failures": sum(1 for r in results if not r.ok),
         "results": [r.to_dict() for r in results],

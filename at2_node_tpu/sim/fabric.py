@@ -232,6 +232,33 @@ class SimMesh:
             if peer.exchange_public not in skip:
                 self.send(peer, frame)
 
+    # -- membership (net.peers.Mesh parity) -------------------------------
+    # Removal doubles as the post-grace attestation filter exactly like
+    # the real mesh: _deliver drops frames whose source is no longer in
+    # by_sign ("unauth"), and the broadcast stack rejects origins missing
+    # from by_sign.
+
+    def add_peer(self, peer: Peer) -> bool:
+        if (
+            peer.sign_public == self.own_sign
+            or peer.exchange_public in self.by_exchange
+        ):
+            return False
+        self.peers.append(peer)
+        self.by_exchange[peer.exchange_public] = peer
+        self.by_sign[peer.sign_public] = peer
+        return True
+
+    def remove_peer(self, sign_public: bytes) -> bool:
+        peer = self.by_sign.pop(sign_public, None)
+        if peer is None:
+            return False
+        self.by_exchange.pop(peer.exchange_public, None)
+        self.peers = [
+            p for p in self.peers if p.exchange_public != peer.exchange_public
+        ]
+        return True
+
 
 class SimChannel:
     """The transport ``Channel`` duck type (send/recv/close/peer_public)

@@ -17,13 +17,18 @@ seed; under ``SimScheduler`` the entire run is a pure function of
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import os
 import random
+import shutil
+import tempfile
 from typing import Dict, List, Optional
 
+from ..broadcast.messages import ConfigTx
 from ..crypto.keys import ExchangeKeyPair, SignKeyPair
 from ..crypto.verifier import CpuVerifier
 from ..net.peers import Peer
-from ..node.config import Config
+from ..node.config import Config, MembershipConfig, StoreConfig
 from ..node.service import Service
 from ..proto import at2_pb2 as pb
 from ..types import transfer_signing_bytes
@@ -80,6 +85,11 @@ def sim_client(seed: int, i: int) -> SignKeyPair:
     )
 
 
+def sim_admin(seed: int) -> SignKeyPair:
+    """Deterministic fleet-admin identity (signs ConfigTx transitions)."""
+    return SignKeyPair(hashlib.sha256(f"at2-sim-admin-{seed}".encode()).digest())
+
+
 class SimNet:
     """``n`` correct nodes (+ ``hostile`` configured-but-unstarted
     byzantine identities) on one fabric. Construct, ``start()``, drive
@@ -96,6 +106,9 @@ class SimNet:
         link: Optional[LinkModel] = None,
         echo_threshold: Optional[int] = None,
         ready_threshold: Optional[int] = None,
+        durable: bool = False,
+        store_root: Optional[str] = None,
+        membership_grace: Optional[float] = None,
         **config_overrides,
     ) -> None:
         self.n = n
@@ -122,6 +135,22 @@ class SimNet:
         self.echo_threshold = echo_threshold
         self.ready_threshold = ready_threshold
 
+        # durability: per-node sharded store dirs under one root. The sim
+        # always runs the store with sync="always" so an abrupt crash()
+        # loses nothing the WAL claims durable — the torn-write cases are
+        # exercised separately through the store's failpoint seam.
+        self.durable = durable or store_root is not None
+        self._own_store_root = False
+        self.store_root = store_root
+        if self.durable and self.store_root is None:
+            self.store_root = tempfile.mkdtemp(prefix="at2-sim-store-")
+            self._own_store_root = True
+
+        # membership: a deterministic fleet admin; membership_grace not
+        # None arms every node's MembershipManager with that grace window
+        self.admin_key = sim_admin(seed)
+        self.membership_grace = membership_grace
+
         keys = [sim_keypairs(seed, i) for i in range(total)]
         peers = [
             Peer(f"sim-{i}:0", keys[i][1].public, keys[i][0].public)
@@ -140,11 +169,32 @@ class SimNet:
                 **config_overrides,
             )
             cfg.nodes = [p for j, p in enumerate(peers) if j != i]
+            if self.durable and "store" not in config_overrides:
+                cfg.store = StoreConfig(
+                    dir=os.path.join(self.store_root, f"node-{i}"),
+                    sync="always",
+                    shards=8,
+                )
+            if membership_grace is not None and "membership" not in config_overrides:
+                cfg.membership = MembershipConfig(
+                    admin_public=self.admin_key.public.hex(),
+                    grace=membership_grace,
+                )
             self.configs.append(cfg)
 
         self.services: List[Service] = []
         self.hostile_configs = self.configs[n:]
         self.touched: set = set()  # account keys episodes interacted with
+        self.down: set = set()  # node indexes crashed and not yet restarted
+        self._incarnation: Dict[int, int] = {}
+        # no-post-restart-equivocation invariant: every attestation a
+        # node SIGNS (via Broadcast.on_attest), keyed by
+        # (node, phase, origin, seq), across ALL incarnations. A second
+        # signing of the same slot with a different content hash is a
+        # broadcast-safety violation — exactly what the persisted
+        # watermark floors exist to prevent.
+        self._attest: Dict[tuple, bytes] = {}
+        self.attest_violations: List[str] = []
         self._started = False
         self.verifier = CpuVerifier()
 
@@ -152,26 +202,51 @@ class SimNet:
 
     def start(self) -> "SimNet":
         for i in range(self.n):
-            cfg = self.configs[i]
-            mesh_factory = lambda c, on_frame: SimMesh(  # noqa: E731
-                self.fabric, c.sign_key.public, c.nodes, on_frame
-            )
-            service = self.loop.run_until_complete(
-                Service.start(
-                    cfg,
-                    verifier=self.verifier,
-                    clock=self.clock,
-                    mesh_factory=mesh_factory,
-                    serve_rpc=False,
-                )
-            )
-            # catchup session nonces from the net seed, not secrets
-            service._nonce_bits = random.Random(
-                (self.seed << 8) | i
-            ).getrandbits
-            self.services.append(service)
+            self.services.append(self._start_node(i))
         self._started = True
         return self
+
+    def _start_node(self, i: int) -> Service:
+        return self.loop.run_until_complete(self._astart_node(i))
+
+    async def _astart_node(self, i: int) -> Service:
+        """Bring up node ``i`` from its config (first boot or restart):
+        fresh SimMesh (``fabric.register`` overwrites, so a restarted
+        node simply replaces its dead mesh), shared verifier, seeded
+        catchup nonces salted with the node's incarnation count."""
+        cfg = self.configs[i]
+        mesh_factory = lambda c, on_frame: SimMesh(  # noqa: E731
+            self.fabric, c.sign_key.public, c.nodes, on_frame
+        )
+        service = await Service.start(
+            cfg,
+            verifier=self.verifier,
+            clock=self.clock,
+            mesh_factory=mesh_factory,
+            serve_rpc=False,
+        )
+        # catchup session nonces from the net seed, not secrets
+        incarnation = self._incarnation.get(i, 0)
+        service._nonce_bits = random.Random(
+            ((self.seed << 8) | i) ^ (incarnation * 0x9E3779B9)
+        ).getrandbits
+        if service.broadcast is not None:
+            service.broadcast.on_attest = self._attest_hook(i)
+        return service
+
+    def _attest_hook(self, i: int):
+        def hook(phase, origin, sequence, chash) -> None:
+            key = (i, phase, bytes(origin), int(sequence))
+            prev = self._attest.get(key)
+            if prev is None:
+                self._attest[key] = bytes(chash)
+            elif prev != bytes(chash):
+                self.attest_violations.append(
+                    f"equivocation: node {i} signed phase {phase} slot "
+                    f"({bytes(origin).hex()[:16]}, {sequence}) with two contents"
+                )
+
+        return hook
 
     def close(self) -> None:
         for s in self.services:
@@ -186,6 +261,100 @@ class SimNet:
             pass
         self.loop.close()
         asyncio.set_event_loop(None)
+        if self._own_store_root and self.store_root:
+            shutil.rmtree(self.store_root, ignore_errors=True)
+
+    # -- node lifecycle (crash / restart) ----------------------------------
+
+    def crash(self, i: int) -> None:
+        """Abrupt death of node ``i``: tasks cancelled, mesh closed, NO
+        final store flush and no graceful shutdown drain — whatever the
+        WAL holds is all a restart gets (sync="always" in the sim, so
+        that is every committed slot)."""
+        self.loop.run_until_complete(self._acrash(i))
+
+    async def _acrash(self, i: int) -> None:
+        if i in self.down:
+            return
+        s = self.services[i]
+        self.down.add(i)
+        s._closing = True
+        for task in (
+            getattr(s, "_catchup_task", None),
+            getattr(s, "_stats_task", None),
+            getattr(s, "_slo_task", None),
+            getattr(s, "_checkpoint_task", None),
+            getattr(s, "_store_task", None),
+            getattr(s, "_membership_task", None),
+            getattr(s, "_batch_flush_task", None),
+            getattr(s, "_delivery_task", None),
+        ):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        if s.broadcast is not None:
+            await s.broadcast.close()
+        if s.mesh is not None:
+            await s.mesh.close()
+        if s.store is not None:
+            # close the WAL fd only — deliberately no flush/set_meta: the
+            # on-disk state is whatever the last flush + WAL tail say
+            s.store.close()
+        self.fabric._record("crash", s.config.sign_key.public, b"", b"")
+
+    def restart(self, i: int) -> Service:
+        return self.loop.run_until_complete(self.arestart(i))
+
+    async def arestart(self, i: int) -> Service:
+        """Restart a crashed node from its durable store: same identity
+        and config, fresh mesh registered over the dead one, recovery
+        path (segments -> WAL replay -> catchup) runs inside
+        ``Service.start``."""
+        if i not in self.down:
+            raise RuntimeError(f"node {i} is not down")
+        self._incarnation[i] = self._incarnation.get(i, 0) + 1
+        service = await self._astart_node(i)
+        self.services[i] = service
+        self.down.discard(i)
+        self.fabric._record("boot", service.config.sign_key.public, b"", b"")
+        return service
+
+    def flush_store(self, i: int) -> None:
+        """Force node ``i``'s store flush (segment fold + manifest
+        commit). The sim drives flushes explicitly — no periodic tasks —
+        so episodes control exactly which state a crash preserves."""
+        svc = self.services[i]
+        if svc.store is not None:
+            self.loop.run_until_complete(svc._store_flush())
+
+    # -- membership driving ------------------------------------------------
+
+    async def areconfig(
+        self, node: int, change: dict, *, epoch: Optional[int] = None
+    ) -> ConfigTx:
+        """Build an admin-signed ConfigTx for the NEXT epoch and inject
+        it at ``node`` through the service's config handler — the node
+        applies it locally and re-gossips it to the fleet, exactly the
+        production admin path."""
+        svc = self.services[node]
+        if epoch is None:
+            epoch = (svc.membership.epoch if svc.membership else 0) + 1
+        tx = ConfigTx.create(self.admin_key, epoch, change)
+        svc._on_config_tx(None, tx)
+        return tx
+
+    def reconfig(self, node: int, change: dict, **kw) -> ConfigTx:
+        return self.loop.run_until_complete(self.areconfig(node, change, **kw))
+
+    def sweep_membership(self) -> None:
+        """Finalize expired evictions on every live node (the sim has no
+        periodic membership loop; settle() calls this each window)."""
+        for i, s in enumerate(self.services):
+            if i not in self.down and s.membership is not None:
+                s.membership.sweep()
 
     def __enter__(self) -> "SimNet":
         return self.start() if not self._started else self
@@ -294,6 +463,7 @@ class SimNet:
         while t < horizon:
             self.loop.run_for(window)
             t += window
+            self.sweep_membership()
             snap = (
                 tuple(s.committed for s in self.services),
                 tuple(len(s.history) for s in self.services),
@@ -321,7 +491,15 @@ class SimNet:
 
     async def _check(self) -> List[str]:
         violations: List[str] = []
-        services = self.services
+        # crashed-and-not-restarted nodes are excluded: they are allowed
+        # to be behind (that is what restart + catchup repairs)
+        services = [
+            s for i, s in enumerate(self.services) if i not in self.down
+        ]
+
+        # 0. no-post-restart-equivocation: recorded live by the
+        # Broadcast.on_attest hook across every incarnation of each node
+        violations.extend(self.attest_violations)
 
         # every account any node knows about, plus everything submitted
         keys: set = set(self.touched)
@@ -417,6 +595,7 @@ __all__ = [
     "InvariantViolation",
     "SimNet",
     "SimRpcError",
+    "sim_admin",
     "sim_client",
     "sim_keypairs",
 ]

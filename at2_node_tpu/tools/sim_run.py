@@ -10,7 +10,8 @@ must reproduce it byte-identically on any host (CI gates on this).
 Usage:
     python -m at2_node_tpu.tools.sim_run --seed 1 --episodes 50
         [--nodes 4] [--faults 1] [--hostile 1] [--events 30]
-        [--broker] [--minimize] [--trace-out results.json] [--quiet]
+        [--broker] [--durability] [--minimize]
+        [--trace-out results.json] [--quiet]
 
 Exit status: 0 if every episode's invariants held, 1 if any violated
 (the banked JSON then carries each failure's exact replay recipe —
@@ -66,6 +67,12 @@ def main(argv=None) -> int:
                         help="byzantine-broker campaign: distilled-frame "
                         "ingress with broker mutations (dup / reorder / "
                         "garbage / withhold) plus a forged-commit sweep")
+    parser.add_argument("--durability", action="store_true",
+                        help="durability campaign: nodes run on sharded "
+                        "stores and the schedule injects crash/restart "
+                        "cycles, flushes (stale-checkpoint restarts), "
+                        "catchup partitions, and membership reconfigs; "
+                        "invariants add no-post-restart-equivocation")
     parser.add_argument("--minimize", action="store_true",
                         help="greedily minimize each failing schedule")
     parser.add_argument("--trace-out", metavar="PATH",
@@ -105,6 +112,7 @@ def main(argv=None) -> int:
         minimize=args.minimize,
         progress=progress,
         broker=args.broker,
+        durability=args.durability,
     )
     campaign["wall_seconds"] = round(time.monotonic() - wall0, 2)
     campaign["argv"] = sys.argv[1:]

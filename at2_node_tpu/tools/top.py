@@ -8,15 +8,20 @@ and no dependencies beyond the stdlib.
 
 Usage:
     python -m at2_node_tpu.tools.top HOST:PORT [HOST:PORT ...]
-        [--interval 2.0] [--once] [--no-clear] [--json]
-        [--tracez] [--limit N]
+        [--interval 2.0] [--once] [--recovery-deadline 120]
+        [--no-clear] [--json] [--tracez] [--limit N]
 
 ``--once`` renders a single frame and exits — nonzero when any polled
 node is down or reports degraded health, so scripts and CI can gate on
-fleet health; ``--json`` dumps the raw per-node /statusz snapshots
-instead of the table. In watch mode a node that fails to answer renders
-as DOWN and keeps the loop alive — mid-restart nodes are exactly when
-you want the dashboard up.
+fleet health. A node reporting ``recovering`` (store-backed restart
+walking loading_segments -> replaying_wal -> catchup, see
+store/recovery.py) is healthy-but-behind: it passes the gate while its
+recovery ``elapsed_s`` is within ``--recovery-deadline`` seconds and
+fails it after — a restart that never reaches live IS a fleet problem.
+``--json`` dumps the raw per-node /statusz snapshots instead of the
+table. In watch mode a node that fails to answer renders as DOWN and
+keeps the loop alive — mid-restart nodes are exactly when you want the
+dashboard up.
 
 Broker addresses can be polled alongside nodes: a /statusz that reports
 ``role: broker`` renders a broker-shaped row (forwarded transfers/s,
@@ -86,15 +91,32 @@ def _num(snapshot: dict, key: str, default=0):
     return v if isinstance(v, (int, float)) else default
 
 
+def _recovery_cell(recovery: dict) -> str:
+    """Compact progress for the ``recovery`` column: the live stage plus
+    the one counter that says how far along it is."""
+    state = recovery.get("state", "live")
+    if state in ("live", "cold"):
+        return "-"
+    if state == "loading_segments":
+        return (
+            f"seg {recovery.get('segments_loaded', 0)}"
+            f"/{recovery.get('segments_total', 0)}"
+        )
+    if state == "replaying_wal":
+        return f"wal {recovery.get('wal_records_replayed', 0)}"
+    return f"catchup lag {recovery.get('catchup_lag', 0)}"
+
+
 def render_frame(rows, now: float, prev) -> str:
     """One table frame. ``rows`` is [(addr, statusz-or-exception)];
     ``prev`` maps addr -> (t, committed) from the previous frame for the
     tx/s delta. Pure function of its inputs — unit-testable."""
     cols = (
-        f"{'node':<22}{'health':<9}{'tx/s':>8}{'committed':>11}"
+        f"{'node':<22}{'health':<11}{'tx/s':>8}{'committed':>11}"
         f"{'p50 ms':>9}{'p99 ms':>9}{'dlv p99':>9}{'live tr':>9}"
         f"{'rej':>6}{'vrf occ':>9}{'q-wait p99':>12}"
         f"{'backlog':>9}{'dstl rx/ms/dd':>15}{'peers':>7}"
+        f"{'epoch':>7}  {'recovery':<16}"
     )
     lines = [cols, "-" * len(cols)]
     for addr, sz in rows:
@@ -124,7 +146,7 @@ def render_frame(rows, now: float, prev) -> str:
             )
             lines.append(
                 f"{addr:<22}"
-                f"{health.get('status', '?'):<9}"
+                f"{health.get('status', '?'):<11}"
                 f"{rate:>8}"
                 f"{fwd:>11}"
                 f"{_num(flush, 'p50_ms'):>9.1f}"
@@ -137,6 +159,7 @@ def render_frame(rows, now: float, prev) -> str:
                 f"{pend:>9}"
                 f"{drops:>15}"
                 f"{_num(stats, 'broker_registrations'):>7}"
+                f"{'-':>7}  {'-':<16}"
             )
             continue
         stats = sz.get("stats", {})
@@ -164,7 +187,7 @@ def render_frame(rows, now: float, prev) -> str:
         )
         lines.append(
             f"{addr:<22}"
-            f"{health.get('status', '?'):<9}"
+            f"{health.get('status', '?'):<11}"
             f"{rate:>8}"
             f"{committed:>11}"
             f"{_num(life, 'p50_ms'):>9.1f}"
@@ -178,6 +201,8 @@ def render_frame(rows, now: float, prev) -> str:
             f"{dstl_s:>15}"
             f"{_num(health, 'peers_connected'):>4}/"
             f"{_num(health, 'peers_configured'):<2}"
+            f"{_num(health, 'epoch'):>7}  "
+            f"{_recovery_cell(sz.get('recovery', {})):<16}"
         )
     return "\n".join(lines)
 
@@ -240,8 +265,36 @@ async def _poll(addrs, timeout: float):
     return [(f"{h}:{p}", r) for (h, p), r in zip(addrs, results)]
 
 
+def once_verdict(rows, recovery_deadline: float) -> list:
+    """The ``--once`` gate: addresses (with reasons) that fail it.
+    Down and degraded always fail; ``recovering`` fails only past
+    ``recovery_deadline`` seconds of recovery elapsed time. Pure
+    function of its inputs — unit-testable."""
+    bad = []
+    for addr, sz in rows:
+        if isinstance(sz, Exception):
+            bad.append(f"{addr} (down)")
+            continue
+        status = sz.get("health", {}).get("status")
+        if status == "ok":
+            continue
+        if status == "recovering":
+            elapsed = sz.get("recovery", {}).get("elapsed_s", 0.0)
+            if (
+                isinstance(elapsed, (int, float))
+                and elapsed <= recovery_deadline
+            ):
+                continue
+            bad.append(f"{addr} (recovering {elapsed}s > "
+                       f"{recovery_deadline}s deadline)")
+            continue
+        bad.append(f"{addr} ({status})")
+    return bad
+
+
 async def run(addrs, interval: float, once: bool, clear: bool,
-              as_json: bool, out=None) -> int:
+              as_json: bool, out=None,
+              recovery_deadline: float = 120.0) -> int:
     out = out or sys.stdout
     prev: dict = {}
     while True:
@@ -274,12 +327,9 @@ async def run(addrs, interval: float, once: bool, clear: bool,
         if once:
             # scripting/CI contract: nonzero when ANY polled node is
             # unreachable or self-reports degraded health — a fleet
-            # where one node answers is not a healthy fleet
-            bad = [
-                addr for addr, sz in rows
-                if isinstance(sz, Exception)
-                or sz.get("health", {}).get("status") != "ok"
-            ]
+            # where one node answers is not a healthy fleet. Recovering
+            # nodes pass within the deadline (see once_verdict).
+            bad = once_verdict(rows, recovery_deadline)
             if bad:
                 print(f"unhealthy: {', '.join(bad)}", file=sys.stderr)
             return 1 if bad else 0
@@ -293,7 +343,13 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit (nonzero if any node "
-                         "is down or reports degraded health)")
+                         "is down, degraded, or still recovering past "
+                         "the recovery deadline)")
+    ap.add_argument("--recovery-deadline", type=float, default=120.0,
+                    metavar="SECONDS",
+                    help="with --once: how long a node may report "
+                         "'recovering' before it fails the gate "
+                         "(default 120)")
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen")
     ap.add_argument("--json", action="store_true",
@@ -312,7 +368,8 @@ def main(argv=None) -> int:
             )
         return asyncio.run(
             run(addrs, args.interval, args.once,
-                clear=not args.no_clear, as_json=args.json)
+                clear=not args.no_clear, as_json=args.json,
+                recovery_deadline=args.recovery_deadline)
         )
     except KeyboardInterrupt:
         return 0

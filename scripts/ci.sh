@@ -141,6 +141,27 @@ if [ -z "$b1" ] || [ "$b1" != "$b2" ]; then
 fi
 echo "same-seed broker campaign hash reproduced: $b1"
 
+echo "== restart-determinism gate =="
+# Durable sharded ledger (ISSUE 9): kill/restart cycles under load, on
+# real per-node sharded stores (segments + WAL + manifest), with
+# mid-catchup partitions, stale-checkpoint restarts, and membership
+# reconfigs in the schedule. Every episode runs the full AT2 invariant
+# sweep PLUS the no-post-restart-equivocation check (a rebooted node
+# must never re-sign a pre-crash slot with different content). Run
+# twice: the same seed must reproduce the same campaign hash even
+# through crash/restart cycles — recovery is deterministic too.
+restart_hash() {
+  python -m at2_node_tpu.tools.sim_run --seed 13 --episodes 4 \
+    --durability --quiet | sed -n 's/.*hash \([0-9a-f]*\).*/\1/p'
+}
+r1="$(restart_hash)"
+r2="$(restart_hash)"
+if [ -z "$r1" ] || [ "$r1" != "$r2" ]; then
+  echo "restart-determinism gate FAILED: '$r1' != '$r2'" >&2
+  exit 1
+fi
+echo "same-seed restart campaign hash reproduced: $r1"
+
 echo "== scenario-grid smoke gate =="
 # Fleet SLO engine + scenario grid (ISSUE 8): the 2x2 smoke slice
 # (lan/wan3 x steady/flash_crowd) must commit every offered transfer,
