@@ -15,19 +15,36 @@ inside its dependency crates; here every check goes through an async
 
 Selected by node config: ``verifier = "cpu" | "tpu"`` (SURVEY.md §5
 config addition).
+
+Amortized verification (ISSUE 10): both verifiers take a
+``mode = "auto" | "per_sig" | "rlc"``. In RLC mode a flush bucket is
+verified with ONE random-linear-combination check (native engine on CPU,
+the promoted ops/aggregate graph on TPU) instead of per-signature
+passes; a failing batch falls back to **bisection** (:class:`RlcEngine`)
+that recursively splits until culprits are isolated, and an adaptive
+:class:`VerifyRouter` chooses per-sig vs RLC per flush from live batch
+size and a decaying per-source failure rate — a byzantine client salting
+every batch degrades its own traffic to per-sig cost instead of forcing
+O(B log B) bisections on everyone. Verdicts are ALWAYS identical to the
+per-signature path: tainted-A keys are rerouted (never rejected) by the
+certification cache, tainted-R lanes are caught by the engine's
+randomized torsion rounds, and bisection leaves resolve exactly.
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import List, Protocol, Sequence, Tuple
+from typing import Callable, List, Optional, Protocol, Sequence, Tuple
 
 import numpy as np
 
 from ..obs.registry import Histogram
 from .keys import verify_one
+
+_MODE_CODES = {"per_sig": 0, "rlc": 1, "auto": 2}
 
 
 class Verifier(Protocol):
@@ -56,23 +73,42 @@ class CpuVerifier:
     execution model: `num_cpus` broadcast workers each verifying inline,
     `/root/reference/src/bin/server/rpc.rs:125`)."""
 
-    def __init__(self, max_workers: int | None = None) -> None:
+    def __init__(
+        self,
+        max_workers: int | None = None,
+        mode: str = "auto",
+        rlc_min_batch: int = 128,
+    ) -> None:
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
         self._max_workers = self._pool._max_workers
         self.signatures_verified = 0
+        self.router = VerifyRouter(mode, min_batch=rlc_min_batch)
+        self.engine = RlcEngine()
+
+    @property
+    def mode(self) -> str:
+        return self.router.mode
 
     def stats(self) -> dict:
-        return {"signatures": self.signatures_verified}
+        return {
+            "signatures": self.signatures_verified,
+            "mode": _MODE_CODES[self.router.mode],
+            "mode_name": self.router.mode,
+            **self.router.stats(),
+            **self.engine.stats(),
+        }
 
     async def warmup(self) -> None:
-        """Build/load the native ingest library off the event loop (its
-        bulk-verify path uses it; Broadcast.start covers the parse path
-        for every verifier configuration)."""
+        """Build/load the native ingest AND rlc libraries off the event
+        loop (the bulk-verify and RLC paths use them; Broadcast.start
+        covers the parse path for every verifier configuration)."""
         from ..native import ingest_available
+        from ..native.rlc import rlc_available
 
-        await asyncio.get_running_loop().run_in_executor(
-            self._pool, ingest_available
-        )
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(self._pool, ingest_available)
+        if self.router.mode != "per_sig":
+            await loop.run_in_executor(self._pool, rlc_available)
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         loop = asyncio.get_running_loop()
@@ -97,6 +133,22 @@ class CpuVerifier:
 
         from ..native import ingest_ready_or_kick, verify_bulk_native
 
+        # Amortized route (ISSUE 10): ONE RLC check for the whole chunk
+        # when the router says the batch is big and clean enough. The
+        # engine resolves exact per-entry verdicts (bisection on batch
+        # failure), so callers can't tell the routes apart except by
+        # speed; verdict outcomes feed the router's per-source EWMA on
+        # both routes.
+        if self.router.mode != "per_sig":
+            sources = [it[0] for it in items]
+            route = self.router.choose(sources, rlc_ready=self.engine.ready())
+            if route == "rlc":
+                results = await loop.run_in_executor(
+                    self._pool, self.engine.verify_batch, items
+                )
+                self.router.observe(list(zip(sources, results)))
+                return results
+
         # The one-C-call path has fixed staging cost (ragged ndarray
         # packing, ctypes crossing) that only amortizes on real batches;
         # trickle-sized chunks stay on the slice path (measured on the
@@ -113,7 +165,9 @@ class CpuVerifier:
             result = await loop.run_in_executor(
                 self._pool, verify_bulk_native, items, n_threads
             )
-            return result.tolist()
+            out = result.tolist()
+            self._observe(items, out)
+            return out
 
         slices = min(n, self._max_workers)
         step = (n + slices - 1) // slices
@@ -125,10 +179,20 @@ class CpuVerifier:
             loop.run_in_executor(self._pool, run, items[i : i + step])
             for i in range(0, n, step)
         ]
-        out: List[bool] = []
+        out = []
         for results in await asyncio.gather(*futs):
             out.extend(results)
+        self._observe(items, out)
         return out
+
+    def _observe(self, items, results: Sequence[bool]) -> None:
+        """Per-sig verdicts still train the router's failure EWMA, so a
+        salting source stays routed per-sig while misbehaving and decays
+        back to RLC eligibility once it stops."""
+        if self.router.mode == "auto":
+            self.router.observe(
+                [(it[0], bool(ok)) for it, ok in zip(items, results)]
+            )
 
     async def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -168,6 +232,329 @@ class _Pending:
     enqueued_at: float
 
 
+class VerifyRouter:
+    """Per-flush routing between per-signature and RLC verification.
+
+    Policy (ISSUE 10): route a flush to RLC only when (a) the engine is
+    ready, (b) the batch is big enough that one RLC check beats B
+    per-sig checks (the fixed torsion-round cost dominates small
+    batches — BENCH_AGGREGATE.json banks the measured crossover), and
+    (c) the batch's *expected bad count* — the sum of a decaying
+    per-source failure EWMA over its entries — stays under budget. A
+    salting source drives its own EWMA toward 1 within one bad flush, so
+    batches carrying its traffic fall back to per-sig cost immediately
+    and recover (EWMA decays on clean observations) when it stops.
+    """
+
+    def __init__(
+        self,
+        mode: str = "auto",
+        *,
+        min_batch: int = 128,
+        decay: float = 0.2,
+        expected_bad_budget: float = 0.5,
+        max_sources: int = 8192,
+    ) -> None:
+        if mode not in _MODE_CODES:
+            raise ValueError(f"unknown verifier mode: {mode!r}")
+        self.mode = mode
+        self.min_batch = min_batch
+        self.decay = decay
+        self.expected_bad_budget = expected_bad_budget
+        self.max_sources = max_sources
+        self._fail_ewma: dict[bytes, float] = {}
+        self._lock = threading.Lock()
+        self.route_rlc = 0
+        self.route_per_sig = 0
+        self.last_route = "per_sig"
+        self.last_batch = 0
+        self.last_expected_bad = 0.0
+        # routing DISTRIBUTIONS: lanes per flush by chosen route — the
+        # crossover evidence /metrics needs (a healthy auto node shows
+        # rlc lanes clustered at full buckets, per-sig at trickles)
+        self.h_rlc_lanes = Histogram("route_rlc_lanes", "lanes per RLC-routed flush")
+        self.h_per_sig_lanes = Histogram(
+            "route_per_sig_lanes", "lanes per per-sig-routed flush"
+        )
+
+    def expected_bad(self, sources: Sequence[bytes]) -> float:
+        ewma = self._fail_ewma
+        return sum(ewma.get(s, 0.0) for s in sources)
+
+    def choose(
+        self, sources: Sequence[bytes], *, rlc_ready: bool = True
+    ) -> str:
+        """Route one flush: ``"rlc"`` or ``"per_sig"``."""
+        n = len(sources)
+        if self.mode == "per_sig" or not rlc_ready:
+            route = "per_sig"
+            exp_bad = 0.0
+        elif self.mode == "rlc":
+            route = "rlc"
+            exp_bad = 0.0
+        else:
+            exp_bad = self.expected_bad(sources)
+            route = (
+                "rlc"
+                if n >= self.min_batch and exp_bad <= self.expected_bad_budget
+                else "per_sig"
+            )
+        with self._lock:
+            if route == "rlc":
+                self.route_rlc += 1
+                self.h_rlc_lanes.observe(float(n))
+            else:
+                self.route_per_sig += 1
+                self.h_per_sig_lanes.observe(float(n))
+            self.last_route = route
+            self.last_batch = n
+            self.last_expected_bad = exp_bad
+        return route
+
+    def observe(self, outcomes: Sequence[Tuple[bytes, bool]]) -> None:
+        """Feed per-entry verdicts back into the per-source failure EWMA
+        (both routes observe, so a salter stays hot even while its
+        traffic runs per-sig, and decays back once it behaves)."""
+        d = self.decay
+        with self._lock:
+            ewma = self._fail_ewma
+            for src, ok in outcomes:
+                p = ewma.get(src, 0.0)
+                p += d * ((0.0 if ok else 1.0) - p)
+                if p < 1e-4:
+                    ewma.pop(src, None)
+                else:
+                    ewma[src] = p
+            while len(ewma) > self.max_sources:
+                # bounded state: drop the coldest source
+                coldest = min(ewma, key=ewma.get)
+                del ewma[coldest]
+
+    def hot_sources(self, threshold: float = 0.1) -> int:
+        with self._lock:
+            return sum(1 for p in self._fail_ewma.values() if p > threshold)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "route_rlc": self.route_rlc,
+                "route_per_sig": self.route_per_sig,
+                "route_last": self.last_route,
+                "route_last_batch": self.last_batch,
+                "route_last_expected_bad": round(self.last_expected_bad, 4),
+                "router_sources": len(self._fail_ewma),
+                **self.h_rlc_lanes.flat("route_rlc_lanes"),
+                **self.h_per_sig_lanes.flat("route_per_sig_lanes"),
+            }
+
+
+class RlcEngine:
+    """CPU RLC batch verification with bisection fallback (sync; callers
+    run it on executor threads — the native calls release the GIL).
+
+    One :meth:`verify_batch` call resolves exact per-entry verdicts:
+
+    1. prepare (shared host prep: s-range checks, h = SHA-512 mod L);
+    2. certify public keys through the per-key cache — exact [L]A once
+       per distinct key; lanes whose A is tainted/undecodable reroute to
+       the exact per-sig path (their cofactorless verdict can differ
+       from any batched check, so they never enter the RLC equation);
+    3. ONE native RLC check (equation + randomized R-torsion rounds)
+       over the remaining lanes;
+    4. on failure, bisect: recursively split and re-check halves with
+       fresh randomness until sub-batches pass whole or shrink to
+       ``leaf_size``, then resolve leaves exactly per-signature — a
+       poison entry costs ~2·log2(B/leaf) extra checks, everyone else
+       still verifies amortized.
+
+    ``check_fn``/``leaf_fn`` are injectable for tests (check counting
+    without curve work).
+    """
+
+    def __init__(
+        self,
+        *,
+        leaf_size: int = 16,
+        k_rounds: int | None = None,
+        cert_cache_max: int = 65536,
+        check_fn: Optional[Callable] = None,
+        leaf_fn: Optional[Callable] = None,
+    ) -> None:
+        from ..native import rlc as rlc_native
+
+        self._rlc = rlc_native
+        self.leaf_size = leaf_size
+        self.k_rounds = (
+            k_rounds if k_rounds is not None else rlc_native.TORSION_ROUNDS
+        )
+        self.cert_cache_max = cert_cache_max
+        self._check_fn = check_fn
+        self._leaf_fn = leaf_fn
+        self._cert: dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        # counters (locked: CpuVerifier's pool may run two batches at once)
+        self.rlc_batches = 0
+        self.rlc_fallbacks = 0
+        self.rlc_checks = 0
+        self.rlc_sigs = 0
+        self.rlc_anomalies = 0
+        self.bisection_depth = 0
+        self.leaf_sigs = 0
+        self.cert_misses = 0
+        self.exact_reroutes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rlc_batches": self.rlc_batches,
+                "rlc_fallbacks": self.rlc_fallbacks,
+                "rlc_checks": self.rlc_checks,
+                "rlc_sigs": self.rlc_sigs,
+                "rlc_anomalies": self.rlc_anomalies,
+                "bisection_depth": self.bisection_depth,
+                "leaf_sigs": self.leaf_sigs,
+                "certified_keys": len(self._cert),
+                "cert_misses": self.cert_misses,
+                "exact_reroutes": self.exact_reroutes,
+            }
+
+    def ready(self) -> bool:
+        return self._rlc.rlc_ready_or_kick()
+
+    # -- certification cache ---------------------------------------------
+
+    def _certify(self, pks: Sequence[bytes]) -> np.ndarray:
+        """Per-lane verdicts from the cache: True when the key's A is
+        certified torsion-free (safe for the RLC equation)."""
+        cache = self._cert
+        misses: list[bytes] = []
+        seen: set = set()
+        for pk in pks:
+            if pk not in cache and pk not in seen:
+                seen.add(pk)
+                misses.append(pk)
+        if misses:
+            verdicts = self._rlc.certify_keys(misses)
+            with self._lock:
+                self.cert_misses += len(misses)
+                for pk, v in zip(misses, verdicts):
+                    cache[pk] = int(v)
+                while len(cache) > self.cert_cache_max:
+                    cache.pop(next(iter(cache)))
+        return np.fromiter(
+            (cache.get(pk, 0) == 2 for pk in pks), dtype=bool, count=len(pks)
+        )
+
+    # -- checking --------------------------------------------------------
+
+    def _check(self, prep, idxs: np.ndarray):
+        """One RLC check over the lanes in ``idxs``. Returns
+        (batch_ok, decomp_ok-over-idxs)."""
+        a, r, s_le, h_le, _valid = prep
+        with self._lock:
+            self.rlc_checks += 1
+        if self._check_fn is not None:
+            return self._check_fn(prep, idxs)
+        sub_valid = np.ones(len(idxs), dtype=bool)
+        return self._rlc.rlc_check(
+            r[idxs], a[idxs], s_le[idxs], h_le[idxs], sub_valid,
+            k_rounds=self.k_rounds,
+        )
+
+    def _leaf(self, items, idxs: np.ndarray, verdicts: np.ndarray) -> None:
+        """Exact per-signature resolution of a bisection leaf."""
+        with self._lock:
+            self.leaf_sigs += len(idxs)
+        if self._leaf_fn is not None:
+            res = self._leaf_fn(items, idxs)
+        else:
+            from ..native import ingest_available, verify_bulk_native
+
+            chunk = [items[int(i)] for i in idxs]
+            if ingest_available():
+                res = verify_bulk_native(chunk, 1)
+            else:
+                res = [verify_one(pk, m, s) for pk, m, s in chunk]
+        for i, ok in zip(idxs, res):
+            verdicts[int(i)] = bool(ok)
+
+    def _bisect(
+        self, prep, items, idxs: np.ndarray, verdicts: np.ndarray, depth: int
+    ) -> None:
+        """Resolve ``idxs`` (known to have failed a check) exactly."""
+        with self._lock:
+            if depth > self.bisection_depth:
+                self.bisection_depth = depth
+        if len(idxs) <= self.leaf_size:
+            self._leaf(items, idxs, verdicts)
+            return
+        mid = len(idxs) // 2
+        halves = (idxs[:mid], idxs[mid:])
+        results = []
+        for half in halves:
+            ok, decomp = self._check(prep, half)
+            results.append((half, ok, decomp))
+        if all(ok for _, ok, _ in results):
+            # the parent failed but both halves pass: a torsion round
+            # fired on the parent and missed on both halves (probability
+            # 2^-k each) — resolve everything exactly rather than trust
+            # either verdict
+            with self._lock:
+                self.rlc_anomalies += 1
+            self._leaf(items, idxs, verdicts)
+            return
+        for half, ok, decomp in results:
+            if ok:
+                verdicts[half[decomp]] = True  # non-decomp lanes stay False
+            else:
+                sub = half[decomp]
+                if len(sub):
+                    self._bisect(prep, items, sub, verdicts, depth + 1)
+
+    def verify_batch(
+        self, items: Sequence[Tuple[bytes, bytes, bytes]]
+    ) -> List[bool]:
+        """Exact per-entry verdicts for one flush, RLC-amortized."""
+        from ..ops import ed25519 as ed_ops
+
+        n = len(items)
+        pks = [it[0] for it in items]
+        msgs = [it[1] for it in items]
+        sigs = [it[2] for it in items]
+        prep = ed_ops.prepare_batch(pks, msgs, sigs)
+        a, r, s_le, h_le, valid = prep
+        verdicts = np.zeros(n, dtype=bool)
+
+        if self._check_fn is None:
+            cert_ok = self._certify(pks)
+        else:
+            cert_ok = np.ones(n, dtype=bool)
+        rlc_lanes = np.flatnonzero(valid[:n] & cert_ok)
+        exact_lanes = np.flatnonzero(valid[:n] & ~cert_ok)
+        with self._lock:
+            self.rlc_batches += 1
+            self.rlc_sigs += len(rlc_lanes)
+            self.exact_reroutes += len(exact_lanes)
+
+        if len(rlc_lanes) <= self.leaf_size:
+            # not enough amortizable lanes to beat per-sig: resolve exact
+            if len(rlc_lanes):
+                self._leaf(items, rlc_lanes, verdicts)
+        else:
+            ok, decomp = self._check(prep, rlc_lanes)
+            if ok:
+                verdicts[rlc_lanes[decomp]] = True
+            else:
+                with self._lock:
+                    self.rlc_fallbacks += 1
+                sub = rlc_lanes[decomp]
+                if len(sub):
+                    self._bisect(prep, items, sub, verdicts, 1)
+        if len(exact_lanes):
+            self._leaf(items, exact_lanes, verdicts)
+        return verdicts.tolist()
+
+
 class TpuBatchVerifier:
     """Accumulate -> pad to bucket -> one XLA dispatch -> resolve futures.
 
@@ -196,12 +583,27 @@ class TpuBatchVerifier:
         buckets: Sequence[int] | None = None,
         max_queue: int | None = None,
         clock=None,
+        mode: str = "auto",
+        rlc_min_batch: int | None = None,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
 
         self.batch_size = batch_size
         self.max_delay = max_delay
         self._clock = SYSTEM_CLOCK if clock is None else clock
+        # Routing (ISSUE 10): on-chip the Pallas per-sig kernel already
+        # wins at every banked bucket (AGGREGATE_r02 measured the one-MSM
+        # certificate shape SLOWER than per-sig on TPU), so ``auto``
+        # never routes a TPU flush to RLC unless the operator opts in
+        # with an explicit ``rlc_min_batch``; ``mode="rlc"`` forces it
+        # (the CPU twin is where auto-RLC pays — see CpuVerifier).
+        self.router = VerifyRouter(
+            mode,
+            min_batch=rlc_min_batch if rlc_min_batch is not None else 1 << 30,
+        )
+        self.rlc_batches = 0
+        self.rlc_fallbacks = 0
+        self.rlc_reroutes = 0
         if buckets is None:
             # One bucket == one compiled program: a flush never exceeds
             # batch_size, so padding to it keeps every dispatch the same
@@ -298,6 +700,12 @@ class TpuBatchVerifier:
             # queue-wait DISTRIBUTION: the tail the means can't show
             # (benches bank p50/p99 from here — ISSUE 3 satellite)
             **self.h_queue_wait.flat("queue_wait"),
+            "mode": _MODE_CODES[self.router.mode],
+            "mode_name": self.router.mode,
+            "rlc_batches": self.rlc_batches,
+            "rlc_fallbacks": self.rlc_fallbacks,
+            "rlc_reroutes": self.rlc_reroutes,
+            **self.router.stats(),
         }
 
     def stage_histograms(self) -> dict:
@@ -537,6 +945,32 @@ class TpuBatchVerifier:
             self._launch(self._prep(pks, msgs, sigs, bucket)), len(pks)
         )
 
+    # -- RLC stages (ISSUE 10): same three-thread pipeline shape, but the
+    # device dispatch is ONE classified RLC check (ops.aggregate) instead
+    # of the per-sig kernel; _complete interprets the (eq_ok, codes)
+    # verdict and falls back to one exact per-sig kernel pass when the
+    # equation fails or any lane needs rerouting ---------------------------
+
+    def _prep_rlc(self, pks, msgs, sigs, bucket):
+        from ..ops import aggregate as agg
+
+        return agg.rlc_prep(pks, msgs, sigs, bucket)
+
+    def _launch_rlc(self, packed):
+        from ..ops import aggregate as agg
+
+        return agg.rlc_launch(packed)
+
+    def _finish_rlc(self, handle, n: int):
+        from ..ops import aggregate as agg
+
+        return agg.rlc_finish(handle, n)
+
+    def _run_batch_rlc(self, pks, msgs, sigs, bucket):
+        return self._finish_rlc(
+            self._launch_rlc(self._prep_rlc(pks, msgs, sigs, bucket)), len(pks)
+        )
+
     def _staged_overrides_consistent(self) -> bool:
         """True when the staged pipeline reflects this instance's actual
         verify logic: either nothing is overridden, or the stages are.
@@ -563,6 +997,10 @@ class TpuBatchVerifier:
         msg = b"verifier warmup"
         sig = kp.sign(msg)
         loop = asyncio.get_running_loop()
+        warm_rlc = (
+            self.router.mode == "rlc"
+            or (self.router.mode == "auto" and self.router.min_batch < (1 << 30))
+        ) and self._staged_overrides_consistent()
         for bucket in self.buckets:
             out = await loop.run_in_executor(
                 self._device_pool, self._run_batch, [kp.public], [msg], [sig], bucket
@@ -571,6 +1009,15 @@ class TpuBatchVerifier:
                 raise RuntimeError(
                     f"verifier warm-up failed for bucket {bucket}"
                 )
+            if warm_rlc:
+                eq_ok, codes = await loop.run_in_executor(
+                    self._device_pool,
+                    self._run_batch_rlc, [kp.public], [msg], [sig], bucket,
+                )
+                if not (bool(eq_ok) and int(codes[0]) == 1):
+                    raise RuntimeError(
+                        f"rlc warm-up failed for bucket {bucket}"
+                    )
         ok = await self.verify(kp.public, msg, sig)
         if not ok:
             raise RuntimeError("verifier warm-up batch failed to verify")
@@ -603,25 +1050,40 @@ class TpuBatchVerifier:
         # path's latency budget pays
         self.h_queue_wait.observe(self._clock.monotonic() - batch[0].enqueued_at)
         await self._inflight.acquire()
+        # route THIS flush (ISSUE 10): the decision is per-dispatch, from
+        # live batch size + the per-source failure EWMA; per_sig mode and
+        # subclasses with a legacy _run_batch override always take the
+        # per-sig kernel
+        rlc = (
+            self.router.mode != "per_sig"
+            and self._staged_overrides_consistent()
+            and self.router.choose(pks) == "rlc"
+        )
         # clock starts AFTER the depth gate: avg/last_dispatch_ms measure
         # one batch's prep->results pipeline latency, not queue wait
         t0 = self._clock.monotonic()
         try:
             if self._staged_overrides_consistent():
                 prepared = await loop.run_in_executor(
-                    self._prep_pool, self._prep, pks, msgs, sigs, bucket
+                    self._prep_pool,
+                    self._prep_rlc if rlc else self._prep,
+                    pks, msgs, sigs, bucket,
                 )
                 t1 = self._clock.monotonic()
                 self.total_prep_s += t1 - t0
                 self.h_prep.observe(t1 - t0)
                 handle = await loop.run_in_executor(
-                    self._device_pool, self._launch, prepared
+                    self._device_pool,
+                    self._launch_rlc if rlc else self._launch,
+                    prepared,
                 )
                 t2 = self._clock.monotonic()
                 self.total_launch_s += t2 - t1
                 self.h_launch.observe(t2 - t1)
                 finish = loop.run_in_executor(
-                    self._finish_pool, self._finish, handle, len(batch)
+                    self._finish_pool,
+                    self._finish_rlc if rlc else self._finish,
+                    handle, len(batch),
                 )
             else:
                 # legacy seam: subclass replaced _run_batch only — run it
@@ -636,14 +1098,50 @@ class TpuBatchVerifier:
             if isinstance(exc, asyncio.CancelledError):
                 raise
             return
-        task = loop.create_task(self._complete(batch, bucket, finish, t0))
+        task = loop.create_task(self._complete(batch, bucket, finish, t0, rlc))
         self._completions.add(task)
         task.add_done_callback(self._completions.discard)
 
-    async def _complete(self, batch, bucket, finish, t0) -> None:
+    async def _resolve_rlc(self, batch, bucket, out) -> np.ndarray:
+        """Turn an RLC stage result into exact per-lane verdicts.
+
+        Clean case (equation holds, no reroutes): the codes ARE the
+        verdicts. Otherwise fall back to ONE exact per-signature kernel
+        pass over the same flush — on-chip that single dispatch resolves
+        every lane at once, so it IS the degenerate bisection leaf (the
+        recursive split only pays on the CPU engine, where leaf cost is
+        per-signature). Runs while _inflight is still held: the fallback
+        occupies this batch's pipeline slot, not a new one."""
+        eq_ok, codes = out
+        self.rlc_batches += 1
+        reroutes = int((codes == 2).sum())
+        self.rlc_reroutes += reroutes
+        if eq_ok and not reroutes:
+            results = codes == 1
+        else:
+            self.rlc_fallbacks += 1
+            loop = asyncio.get_running_loop()
+            pks = [p.public_key for p in batch]
+            msgs = [p.message for p in batch]
+            sigs = [p.signature for p in batch]
+            results = await loop.run_in_executor(
+                self._device_pool, self._run_batch, pks, msgs, sigs, bucket
+            )
+        if self.router.mode == "auto":
+            self.router.observe(
+                [
+                    (p.public_key, bool(ok))
+                    for p, ok in zip(batch, results)
+                ]
+            )
+        return results
+
+    async def _complete(self, batch, bucket, finish, t0, rlc=False) -> None:
         t_fin = self._clock.monotonic()
         try:
             results = await finish
+            if rlc:
+                results = await self._resolve_rlc(batch, bucket, results)
         except BaseException as exc:
             self._fail_batch(batch, exc)
             if isinstance(exc, asyncio.CancelledError):

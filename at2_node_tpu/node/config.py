@@ -63,17 +63,41 @@ class VerifierConfig:
     kind: str = "cpu"
     batch_size: int = 256
     max_delay: float = 0.002
+    # Amortized verification (ISSUE 10): "auto" routes big clean batches
+    # to one RLC check per flush (CPU kind; the TPU kind keeps the
+    # per-sig kernel unless forced — its on-chip crossover favors
+    # per-sig, see ops/roofline.model_rlc), "rlc" forces the RLC path,
+    # "per_sig" pins the historical behavior.
+    mode: str = "auto"
+    # Smallest flush worth one RLC check. None keeps each verifier's
+    # default: 128 on the CPU engine, opt-out (1<<30) on TPU where the
+    # per-sig kernel wins on-chip — setting it is the operator's opt-in.
+    rlc_min_batch: Optional[int] = None
 
     def make(self):
         from ..crypto.verifier import make_verifier
 
+        rlc_kw = (
+            {} if self.rlc_min_batch is None
+            else {"rlc_min_batch": self.rlc_min_batch}
+        )
         # Route every kind through make_verifier so "pool" works from
         # config and an unknown kind raises instead of silently degrading
         # the north-star path to per-signature CPU verification.
         if self.kind == "cpu":
-            return make_verifier("cpu")
+            return make_verifier("cpu", mode=self.mode, **rlc_kw)
+        if self.kind == "pool":
+            # the sharded mesh verifier predates RLC routing; it keeps
+            # its per-sig kernel shards regardless of mode
+            return make_verifier(
+                self.kind, batch_size=self.batch_size, max_delay=self.max_delay
+            )
         return make_verifier(
-            self.kind, batch_size=self.batch_size, max_delay=self.max_delay
+            self.kind,
+            batch_size=self.batch_size,
+            max_delay=self.max_delay,
+            mode=self.mode,
+            **rlc_kw,
         )
 
 
@@ -329,7 +353,10 @@ class Config:
             f'kind = "{self.verifier.kind}"',
             f"batch_size = {self.verifier.batch_size}",
             f"max_delay = {self.verifier.max_delay}",
+            f'mode = "{self.verifier.mode}"',
         ]
+        if self.verifier.rlc_min_batch is not None:
+            lines.append(f"rlc_min_batch = {self.verifier.rlc_min_batch}")
         obs = self.observability
         if obs != ObservabilityConfig():
             lines += [

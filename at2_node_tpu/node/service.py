@@ -1111,10 +1111,21 @@ class Service(At2Servicer):
         """Full JSON snapshot for /statusz and tools/top.py: flat stats
         + tx-lifecycle percentiles + verifier pipeline stage histograms."""
         stages = {}
+        routing = {}
         if self.verifier is not None:
             fn = getattr(self.verifier, "stage_histograms", None)
             if callable(fn):
                 stages = fn()
+            router = getattr(self.verifier, "router", None)
+            if router is not None:
+                # the LIVE routing decision (ISSUE 10): which path the
+                # last flush took, why (batch size vs expected bad), and
+                # how many sources the failure EWMA currently tracks
+                routing = {
+                    "mode": router.mode,
+                    **router.stats(),
+                    "hot_sources": router.hot_sources(),
+                }
         return {
             "node": self.config.sign_key.public.hex()[:16],
             "rpc_address": self.config.rpc_address,
@@ -1122,6 +1133,7 @@ class Service(At2Servicer):
             "stats": self.snapshot_stats(),
             "tx_lifecycle": self.tx_trace.snapshot(),
             "verifier_stages": stages,
+            "verifier_routing": routing,
             "slo": self.slo.evaluate(),
             "recovery": self.recovery.to_dict(self.clock.monotonic()),
             "membership": (

@@ -108,13 +108,19 @@ X_IDX, Y_IDX, Z_IDX = ed.X, ed.Y, ed.Z
 
 
 def tree_reduce_points(pts: jnp.ndarray) -> jnp.ndarray:
-    """Sum a (B, 4, 20) stack of points into one point with log2(B)
-    halving rounds of batched additions (B must be a power of two)."""
+    """Sum a (B, 4, 20) stack of points into one point with ceil(log2(B))
+    halving rounds of batched additions. Non-power-of-two stacks fold
+    too: an odd round carries its unpaired tail lane into the next round
+    (shapes are static per round, so XLA still compiles one program per
+    distinct B)."""
     n = pts.shape[0]
     while n > 1:
         half = n // 2
-        pts = ed.add(pts[:half], pts[half : 2 * half])
-        n = half
+        folded = ed.add(pts[:half], pts[half : 2 * half])
+        if n % 2:
+            folded = jnp.concatenate([folded, pts[2 * half :]], axis=0)
+        pts = folded
+        n = pts.shape[0]
     return pts[0]
 
 
@@ -208,6 +214,201 @@ def aggregate_verify(
         jnp.asarray(valid_pad),
     )
     return bool(ok)
+
+
+# -- RLC as a verification mode (ISSUE 10) --------------------------------
+#
+# The certificate check above answers ONE question ("is the whole quorum
+# valid?"). The verifier seam needs more: exact PER-LANE verdicts that
+# always agree with the cofactorless per-signature paths. The staged
+# entries below (rlc_prep / rlc_launch / rlc_finish, mirroring
+# ed25519.prep_packed/launch_packed/finish_packed so TpuBatchVerifier's
+# pipeline threads overlap RLC batches the same way) therefore classify
+# every lane ON DEVICE before trusting the equation:
+#
+# * A undecodable or carrying torsion -> code 2 (reroute): such a lane's
+#   cofactorless verdict can differ from any batched check (a key holder
+#   can plant compensating torsion in A and R), so it must be resolved by
+#   the exact per-signature kernel — rerouted, never rejected.
+# * R undecodable or carrying torsion (A clean) -> code 0: with a
+#   torsion-free A the residual's torsion component equals R's, so the
+#   per-signature check provably rejects — exactly False.
+# * remaining valid lanes -> code 1: they enter the equation. The per-lane
+#   [z_i s_i]B terms fold through the same masked tree as the RHS, so the
+#   equation covers exactly the code-1 lanes no matter which lanes were
+#   excluded on device (the host never needs to know in advance).
+#
+# Verdict: eq_ok -> every code-1 lane verified; else the caller falls back
+# (TpuBatchVerifier runs ONE exact per-sig kernel pass — on-chip that IS
+# the bisection leaf, since the kernel resolves all lanes in one dispatch).
+
+# a(32) | r(32) | z(32) | zh(32) | zs(32) | valid(1)
+RLC_PACKED_WIDTH = 161
+
+
+def _neg_base_table() -> np.ndarray:
+    """Multiples [0..15] of -B, for folding -[z_i s_i]B into the per-lane
+    residual (table build is host-side, once at import)."""
+    acc = (0, 1)
+    out = []
+    for _ in range(16):
+        out.append(ed.point_from_ints((-acc[0]) % fe.P, acc[1]))
+        acc = ed.affine_add_ints(acc, (ed.BX_INT, ed.BY_INT))
+    return np.stack(out)
+
+
+_NEG_BASE_TABLE = _neg_base_table()
+
+
+def _rlc_residuals(r_point, a_point, z_win, zh_win, zs_win):
+    """Per-lane residual e_i = [z_i]R_i + [z_i h_i]A_i - [z_i s_i]B via a
+    triple-scalar Straus (one loop: the -B table is fixed and shared, so
+    the third term costs one lookup+add per window instead of the second
+    full vs_base pass + per-lane table builds a separate lhs would)."""
+    table_r = ed.build_table(r_point)
+    table_a = ed.build_table(a_point)
+    table_nb = jnp.asarray(_NEG_BASE_TABLE)
+    batch_shape = z_win.shape[:-1]
+    acc0 = jnp.broadcast_to(
+        jnp.asarray(ed.IDENTITY), batch_shape + (4, fe.N_LIMBS)
+    )
+    nb = jnp.broadcast_to(table_nb, batch_shape + (16, 4, fe.N_LIMBS))
+
+    def body(w, acc):
+        acc = ed.double(ed.double(ed.double(ed.double(acc))))
+        acc = ed.add(acc, ed._lookup(table_r, z_win[..., w]))
+        acc = ed.add(acc, ed._lookup(table_a, zh_win[..., w]))
+        acc = ed.add(acc, ed._lookup(nb, zs_win[..., w]))
+        return acc
+
+    return jax.lax.fori_loop(0, base.N_WINDOWS, body, acc0)
+
+
+def _rlc_graph_packed(packed: jnp.ndarray):
+    """Jittable per-lane-classified RLC check.
+
+    Returns ``(eq_ok, codes)``: scalar bool (the equation over the code-1
+    lanes) and a (B,) uint8 lane classification (0 = exactly invalid or
+    padding, 1 = in the equation, 2 = reroute to exact per-sig)."""
+    a_bytes = packed[:, :32]
+    r_bytes = packed[:, 32:64]
+    z_le = packed[:, 64:96]
+    zh_le = packed[:, 96:128]
+    zs_le = packed[:, 128:160]
+    valid = packed[:, 160].astype(jnp.bool_)
+
+    a_point, a_ok = ed.decompress(a_bytes)
+    r_point, r_ok = ed.decompress(r_bytes)
+    n_lanes = a_bytes.shape[0]
+    # exact [L]P per lane (invalid encodings decompress to the prime-order
+    # base point, so their torsion verdict is vacuously True and the a_ok/
+    # r_ok bits below carry the rejection)
+    torsion_free = is_identity(
+        mul_by_L(jnp.concatenate([r_point, a_point], axis=0))
+    )
+    r_tf, a_tf = torsion_free[:n_lanes], torsion_free[n_lanes:]
+
+    a_tainted = valid & (~a_ok | ~a_tf)
+    lane_bad = valid & ~a_tainted & (~r_ok | ~r_tf)
+    active = valid & ~a_tainted & ~lane_bad
+
+    z_win = base._windows_on_device(z_le)
+    zh_win = base._windows_on_device(zh_le)
+    zs_win = base._windows_on_device(zs_le)
+
+    # per-lane residuals, masked by the active set before folding — the
+    # equation covers exactly the code-1 lanes no matter which lanes the
+    # classification above excluded (the host never knows in advance)
+    ident = jnp.asarray(ed.IDENTITY)
+    t = _rlc_residuals(r_point, a_point, z_win, zh_win, zs_win)
+    t = jnp.where(active[:, None, None], t, ident)
+    eq_ok = is_identity(tree_reduce_points(t))
+    codes = jnp.where(
+        a_tainted, jnp.uint8(2), jnp.where(active, jnp.uint8(1), jnp.uint8(0))
+    )
+    return eq_ok, codes
+
+
+_rlc_jit = jax.jit(_rlc_graph_packed)
+
+
+class _RlcInFlight:
+    """rlc_launch output: the two in-flight result handles."""
+
+    __slots__ = ("eq", "codes")
+
+    def __init__(self, eq, codes) -> None:
+        self.eq = eq
+        self.codes = codes
+
+
+def rlc_prep(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    batch_size: int | None = None,
+    _z_override: Sequence[int] | None = None,
+) -> np.ndarray:
+    """Pipeline stage 1 (host): batch prep + fresh random coefficients,
+    packed into one (B, RLC_PACKED_WIDTH) row-per-lane array (single H2D
+    transfer, same rationale as ed25519.pack_prepared). ``batch_size``
+    need not be a power of two — the fold tree handles ragged stacks."""
+    from ..native.rlc import make_scalars
+
+    n = len(public_keys)
+    bucket = batch_size if batch_size is not None else n
+    a, r, s_le, h_le, valid = base.prepare_batch(
+        public_keys, messages, signatures, bucket
+    )
+    zo = None
+    if _z_override is not None:
+        zo = list(_z_override) + [1] * (bucket - len(_z_override))
+    z_le, zh_le, zs_le = make_scalars(s_le, h_le, z_override=zo)
+    return np.concatenate(
+        [a, r, z_le, zh_le, zs_le, valid[:, None].astype(np.uint8)], axis=1
+    )
+
+
+def rlc_launch(packed: np.ndarray) -> _RlcInFlight:
+    """Pipeline stage 2 (device): transfer + dispatch + start both async
+    copy-backs; returns without blocking."""
+    eq, codes = _rlc_jit(jax.device_put(packed))
+    for out in (eq, codes):
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass  # stubs / non-array outputs in tests
+    return _RlcInFlight(eq, codes)
+
+
+def rlc_finish(handle: _RlcInFlight, n: int):
+    """Pipeline stage 3: materialize ``(eq_ok, codes[:n])`` — the one
+    blocking sync (B+1 bytes back across the tunnel)."""
+    return bool(np.asarray(handle.eq)), np.asarray(handle.codes)[:n]
+
+
+def rlc_verify_batch(
+    public_keys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    batch_size: int | None = None,
+    _z_override: Sequence[int] | None = None,
+) -> np.ndarray:
+    """Synchronous compose of the RLC stages with exact resolution:
+    reroutes and equation failures fall back to the per-signature kernel,
+    so the returned (n,) verdicts ALWAYS equal ``base.verify_batch``'s."""
+    n = len(public_keys)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    eq_ok, codes = rlc_finish(
+        rlc_launch(
+            rlc_prep(public_keys, messages, signatures, batch_size, _z_override)
+        ),
+        n,
+    )
+    if eq_ok and not (codes == 2).any():
+        return codes == 1
+    return base.verify_batch(public_keys, messages, signatures, batch_size)
 
 
 def verify_certificate(

@@ -103,3 +103,65 @@ def model(device_only_sigs_per_sec: float) -> dict:
             hbm_bound_rate / vpu_bound_rate, 0
         ),
     }
+
+
+# ---- RLC batch-check structure (ISSUE 10), in f_mul units -----------
+#
+# The classified RLC graph (ops/aggregate._rlc_graph_packed) does per
+# LANE: 2 decompressions, one exact [L]P torsion pass over BOTH points
+# (fixed-scalar Straus: 64 windows x (4 doubles + 1 add) each, plus one
+# 16-entry table build per point), one two-variable-point Straus
+# ([z]R + [zh]A: 2 table builds + 64 x (4 doubles + 2 adds)), one
+# vs-base Straus for [z_i s_i]B (base table is precomputed: 64 x
+# (4 doubles + 2 adds), half the lookups hit identity), and ~2 batched
+# tree additions amortized per lane (the fold halves lanes each round:
+# sum_k 2^-k -> 2 adds/lane across both trees). One projective compare
+# (4 muls) amortizes over the whole batch.
+
+_L_STRAUS_FMUL = TABLE_BUILD_FMUL + N_WINDOWS * (4 * DBL_FMUL + ADD_FMUL)
+_TWOVAR_STRAUS_FMUL = 2 * TABLE_BUILD_FMUL + STRAUS_FMUL
+_VSBASE_STRAUS_FMUL = N_WINDOWS * (4 * DBL_FMUL + 2 * ADD_FMUL)
+_TREE_FMUL = 2 * 2 * ADD_FMUL  # ~2 amortized adds/lane in each fold tree
+
+RLC_FMUL_PER_SIG = (
+    2 * DECOMPRESS_FMUL
+    + 2 * _L_STRAUS_FMUL
+    + _TWOVAR_STRAUS_FMUL
+    + _VSBASE_STRAUS_FMUL
+    + _TREE_FMUL
+)
+
+RLC_INT32_OPS_PER_SIG = (
+    RLC_FMUL_PER_SIG * OPS_PER_FMUL + 3 * LOOKUPS_PER_SIG * OPS_PER_LOOKUP
+)
+
+RLC_BYTES_PER_SIG = 161 + 1  # rlc-packed row in, code byte out
+
+
+def model_rlc(device_only_sigs_per_sec: float) -> dict:
+    """Roofline for the on-chip RLC check, against the same VPU ceiling
+    the per-sig kernel is scored on (53% of peak at the banked rate).
+
+    The punchline the router needs: RLC's structural per-lane cost —
+    torsion certification is exact per lane on the chip, unlike the CPU
+    engine's shared randomized rounds — is ~2.3x the per-sig kernel's,
+    so at equal utilization the per-sig kernel WINS on-chip and ``auto``
+    is right to never route TPU flushes to RLC. The CPU story inverts
+    because the native engine's Pippenger MSM makes the per-lane curve
+    cost sublinear, which no fixed-window batch graph matches.
+    """
+    achieved_ops = device_only_sigs_per_sec * RLC_INT32_OPS_PER_SIG
+    vpu_bound_rate = V5E_VPU_INT32_OPS / RLC_INT32_OPS_PER_SIG
+    hbm_bound_rate = V5E_HBM_BYTES / RLC_BYTES_PER_SIG
+    return {
+        "chip_model": "v5e",
+        "rlc_fmul_per_sig": RLC_FMUL_PER_SIG,
+        "rlc_int32_ops_per_sig": RLC_INT32_OPS_PER_SIG,
+        "rlc_vs_per_sig_op_ratio": round(
+            RLC_INT32_OPS_PER_SIG / INT32_OPS_PER_SIG, 2
+        ),
+        "achieved_int32_tops": round(achieved_ops / 1e12, 3),
+        "roofline_pct": round(100.0 * achieved_ops / V5E_VPU_INT32_OPS, 1),
+        "vpu_bound_sigs_per_sec": round(vpu_bound_rate, 0),
+        "hbm_bound_sigs_per_sec": round(hbm_bound_rate, 0),
+    }

@@ -28,7 +28,7 @@ from ..crypto.keys import verify_one
 from ..proto import distill
 from ..types import transfer_signing_bytes
 from .fabric import LinkModel
-from .hostile import HostileFrameGen, mutate_distilled_frame
+from .hostile import HostileFrameGen, SaltingClientGen, mutate_distilled_frame
 from .net import SimNet, sim_client
 
 # An event is [t, kind, args-dict] — JSON-shaped on purpose (banked by
@@ -328,6 +328,103 @@ def generate_broker_events(
     return events
 
 
+def generate_salting_events(
+    rng: random.Random,
+    *,
+    nodes: int = 4,
+    n_clients: int = 4,
+    n_events: int = 30,
+    duration: float = 20.0,
+    hostile: bool = True,
+    faults: bool = True,
+) -> List[Event]:
+    """A batch-poisoning schedule (ISSUE 10): honest traffic — including
+    bulk flushes big enough for the auto router to amortize — interleaved
+    with salted flushes from ONE byzantine client (``salt`` events; the
+    salter identity itself lives in the episode's seeded
+    :class:`SaltingClientGen`). Honest sequences are allocated in TIME
+    order, so with no partitions in the schedule every honest entry is
+    committable the moment it arrives — which is what lets the salting
+    sweep count them as a hard bounded-loss invariant.
+
+    Two anchors are always present regardless of the rolls: an early
+    honest bulk flush (the RLC path must engage at all) and at least two
+    salted flushes (the router must both fall back and converge)."""
+    events: List[Event] = []
+    next_seq = [1] * n_clients
+
+    def bulk_event(t: float) -> Event:
+        c = rng.randrange(n_clients)
+        # above the engine's bisection leaf (16), so the flush exercises
+        # the actual one-check amortized path, not the exact-leaf floor
+        count = rng.randint(18, 32)
+        ev = [
+            t,
+            "bulk",
+            {
+                "node": rng.randrange(nodes),
+                "client": c,
+                "seq0": next_seq[c],
+                "count": count,
+                "to": rng.randrange(n_clients),
+                "amount": rng.randint(1, 20),
+            },
+        ]
+        next_seq[c] += count
+        return ev
+
+    def salt_event(t: float) -> Event:
+        return [
+            t,
+            "salt",
+            {"node": rng.randrange(nodes), "size": rng.choice((24, 32, 40))},
+        ]
+
+    events.append(bulk_event(0.4))
+    events.append(salt_event(1.0))
+    events.append(salt_event(round(duration / 2, 3)))
+    times = sorted(
+        round(rng.uniform(1.5, duration), 3) for _ in range(n_events)
+    )
+    for t in times:
+        roll = rng.random()
+        if roll < 0.25:
+            events.append(salt_event(t))
+        elif roll < 0.40 and hostile:
+            events.append(
+                [
+                    t,
+                    "hostile",
+                    {
+                        "targets": sorted(
+                            rng.sample(range(nodes), rng.randint(1, nodes))
+                        ),
+                        "count": rng.randint(1, 4),
+                    },
+                ]
+            )
+        elif roll < 0.65:
+            events.append(bulk_event(t))
+        else:
+            c = rng.randrange(n_clients)
+            events.append(
+                [
+                    t,
+                    "tx",
+                    {
+                        "node": rng.randrange(nodes),
+                        "client": c,
+                        "seq": next_seq[c],
+                        "to": rng.randrange(n_clients),
+                        "amount": rng.randint(1, 50),
+                    },
+                ]
+            )
+            next_seq[c] += 1
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
 @dataclass
 class EpisodeResult:
     seed: int
@@ -410,6 +507,7 @@ def apply_events(
     events: List[Event],
     clients: List,
     hostile_gen: Optional[HostileFrameGen],
+    salting_gen: Optional[SaltingClientGen] = None,
 ) -> None:
     """Schedule every event onto the net's virtual timeline (relative to
     now). Submissions go through the real SendAsset handler; rejections
@@ -444,6 +542,41 @@ def apply_events(
             net.asubmit(node, client, seq, clients[to_i].public, amount)
         )
         _track(task)
+
+    def bulk(args):
+        """One honest bulk flush through SendAssetBatch: count entries
+        from one client at consecutive sequences — the traffic shape the
+        auto router amortizes through the RLC path."""
+        node = _live(args["node"])
+        if node is None:
+            return
+        client = clients[args["client"]]
+        to = clients[args["to"]].public
+        rows = [
+            (args["seq0"] + j, to, args["amount"], True)
+            for j in range(args["count"])
+        ]
+        _track(loop.create_task(net.asubmit_batch(node, client, rows)))
+
+    def salt(args):
+        """One salted flush from the byzantine client: honest-looking
+        except k bad-signature entries at adversarial positions
+        (SaltingClientGen). All-or-nothing admission rejects the whole
+        flush; the sweep asserts the router then prices this source out
+        of the RLC route."""
+        if salting_gen is None:
+            return
+        node = _live(args["node"])
+        if node is None:
+            return
+        rows = salting_gen.next_flush(args["size"])
+        _track(
+            loop.create_task(
+                net.asubmit_batch(
+                    node, salting_gen.key, rows, source="sim-salter"
+                )
+            )
+        )
 
     # client index -> directory id, filled by "breg" events (first
     # successful registration wins; later "bsub" events read it)
@@ -593,6 +726,10 @@ def apply_events(
             loop.call_later(t, breg, args)
         elif kind == "bsub":
             loop.call_later(t, bsub, args)
+        elif kind == "bulk":
+            loop.call_later(t, bulk, args)
+        elif kind == "salt":
+            loop.call_later(t, salt, args)
         elif kind == "kill":
 
             def kill(args=args):
@@ -687,6 +824,63 @@ def _forged_commit_sweep(net: SimNet) -> List[str]:
     return violations
 
 
+def _salting_sweep(
+    net: SimNet, events: List[Event], salter_pk: bytes
+) -> List[str]:
+    """Batch-poisoning campaign invariants (ISSUE 10), checked against
+    the shared verifier after quiescence:
+
+    * the RLC path engaged at all (an episode that silently ran per-sig
+      everywhere proves nothing),
+    * amortization loss is BOUNDED: at most one RLC fallback per salted
+      flush — a salter can burn the batches it is in, never more,
+    * the router CONVERGED: the salter's failure EWMA prices any
+      min_batch-size flush of its traffic out of the RLC route,
+    * honest throughput survived: every honest scheduled entry committed
+      on every live node, and no salted entry ever did."""
+    violations: List[str] = []
+    n_salt = sum(1 for _t, kind, _a in events if kind == "salt")
+    vs = net.verifier.stats()
+    if not vs.get("rlc_batches", 0):
+        violations.append("salting: RLC path never engaged (rlc_batches == 0)")
+    fallbacks = vs.get("rlc_fallbacks", 0)
+    if n_salt and not fallbacks:
+        violations.append(
+            "salting: no salted flush ever reached the RLC path "
+            "(rlc_fallbacks == 0)"
+        )
+    if fallbacks > n_salt:
+        violations.append(
+            f"salting: unbounded amortization loss — {fallbacks} RLC "
+            f"fallbacks for {n_salt} salted flushes"
+        )
+    router = net.verifier.router
+    if n_salt and router.expected_bad(
+        [salter_pk] * router.min_batch
+    ) <= router.expected_bad_budget:
+        violations.append(
+            "salting: router never converged — a full flush of salter "
+            "traffic would still route to RLC"
+        )
+    expected = sum(1 for _t, k, _a in events if k == "tx") + sum(
+        a["count"] for _t, k, a in events if k == "bulk"
+    )
+    for si, s in enumerate(net.services):
+        if si in net.down:
+            continue
+        if s.committed < expected:
+            violations.append(
+                f"salting: node {si} committed {s.committed}/{expected} "
+                "honest entries (unbounded throughput loss)"
+            )
+        if s.accounts.frontier_nowait().get(salter_pk, 0):
+            violations.append(
+                f"salting: node {si} committed an entry from a salted "
+                "flush (all-or-nothing admission breached)"
+            )
+    return violations
+
+
 def run_episode(
     seed: int,
     *,
@@ -705,6 +899,7 @@ def run_episode(
     capture_obs: Optional[bool] = None,
     broker: bool = False,
     durability: bool = False,
+    salting: bool = False,
 ) -> EpisodeResult:
     """One self-contained episode: fresh SimNet, (generated or given)
     events, run + settle, invariant check, teardown. Pure in
@@ -723,13 +918,21 @@ def run_episode(
     ``durability``: run every node on a durable sharded store with
     membership armed, and generate a crash/restart/reconfig schedule
     (:func:`generate_durability_events`). The invariant sweep then also
-    covers no-post-restart-equivocation (recorded live by the net)."""
+    covers no-post-restart-equivocation (recorded live by the net).
+
+    ``salting``: run the batch-poisoning flavor — the shared verifier in
+    auto mode with a sim-sized RLC threshold, a schedule from
+    :func:`generate_salting_events`, and the amortized-verification
+    invariant sweep (:func:`_salting_sweep`)."""
     wall0 = time.monotonic()
     rng = random.Random(_seed_int("episode", seed))
     sim_kwargs = dict(config_overrides or {})
     if durability:
         sim_kwargs.setdefault("durable", True)
         sim_kwargs.setdefault("membership_grace", 1.0)
+    if salting:
+        sim_kwargs.setdefault("verifier_mode", "auto")
+        sim_kwargs.setdefault("rlc_min_batch", 8)
     net = SimNet(
         nodes,
         f,
@@ -747,6 +950,8 @@ def run_episode(
                 generate = generate_durability_events
             elif broker:
                 generate = generate_broker_events
+            elif salting:
+                generate = generate_salting_events
             else:
                 generate = generate_events
             events = generate(
@@ -765,7 +970,12 @@ def run_episode(
             if hostile > 0
             else None
         )
-        apply_events(net, events, clients, hostile_gen)
+        salting_gen = (
+            SaltingClientGen(random.Random(_seed_int("salter", seed)))
+            if salting
+            else None
+        )
+        apply_events(net, events, clients, hostile_gen, salting_gen)
         last_t = max((e[0] for e in events), default=0.0)
         net.run_for(last_t + 1.0)
         net.fabric.heal_all()
@@ -773,6 +983,10 @@ def run_episode(
         violations = net.check_invariants()
         if broker:
             violations += _forged_commit_sweep(net)
+        if salting:
+            violations += _salting_sweep(
+                net, events, salting_gen.key.public
+            )
         if durability and net.down:
             # a schedule must always reboot what it kills; a node still
             # down at quiescence is a schedule bug, not a safety pass
@@ -923,6 +1137,7 @@ def run_campaign(
     progress: Optional[Callable[[int, "EpisodeResult"], None]] = None,
     broker: bool = False,
     durability: bool = False,
+    salting: bool = False,
 ) -> dict:
     """``episodes`` independent seeded episodes; per-episode seeds derive
     from the campaign seed, failures carry their exact replay recipe
@@ -931,7 +1146,9 @@ def run_campaign(
     across two same-seed runs. ``broker=True`` runs the byzantine-broker
     flavor of every episode (distilled ingress + forged-commit sweep);
     ``durability=True`` the crash/restart/reconfig flavor (durable
-    stores + membership + no-post-restart-equivocation)."""
+    stores + membership + no-post-restart-equivocation);
+    ``salting=True`` the batch-poisoning flavor (amortized verification
+    under a salting client + bounded-loss/router-convergence sweep)."""
     camp_rng = random.Random(_seed_int("campaign", seed))
     results: List[EpisodeResult] = []
     for ep in range(episodes):
@@ -946,6 +1163,7 @@ def run_campaign(
             link=link,
             broker=broker,
             durability=durability,
+            salting=salting,
         )
         if result.violations and minimize:
             result.minimized = minimize_events(
@@ -961,6 +1179,7 @@ def run_campaign(
                         capture_obs=False,
                         broker=broker,
                         durability=durability,
+                        salting=salting,
                     ).violations
                 ),
             )
@@ -978,6 +1197,7 @@ def run_campaign(
         "hostile": hostile,
         "broker": broker,
         "durability": durability,
+        "salting": salting,
         "campaign_hash": h.hexdigest(),
         "failures": sum(1 for r in results if not r.ok),
         "results": [r.to_dict() for r in results],

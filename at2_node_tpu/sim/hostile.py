@@ -69,6 +69,57 @@ def _rng_keypair(rng: random.Random) -> SignKeyPair:
     return SignKeyPair(bytes(rng.getrandbits(8) for _ in range(32)))
 
 
+class SaltingClientGen:
+    """Batch-poisoning byzantine CLIENT: emits bulk-ingress flushes that
+    look honest except ``k_bad`` bad-signature entries at adversarial
+    positions — spread so every bisection level has to split a bad pair,
+    the worst case for an amortized (RLC) batch verifier. The bad entries
+    are REAL signatures with one flipped bit in ``s``: R still decodes
+    and is torsion-free, so they survive every cheap classification and
+    force the batch equation itself to fail.
+
+    Pure like :class:`HostileFrameGen`: seeded rng in, deterministic
+    flush specs out; the sim feeds them through the real
+    ``SendAssetBatch`` handler (`SimNet.asubmit_batch`)."""
+
+    def __init__(self, rng: random.Random, k_bad: int = 3):
+        self.rng = rng
+        self.k_bad = k_bad
+        self.key = _rng_keypair(rng)
+        self.recipient = _rng_keypair(rng).public
+        self._seq = 0
+
+    def positions(self, size: int) -> list:
+        """Adversarial placement: endpoints plus an even spread, so the
+        bad lanes land in different bisection halves at every depth."""
+        k = min(self.k_bad, size)
+        if k <= 0:
+            return []
+        if k == 1:
+            return [0]
+        return sorted({round(i * (size - 1) / (k - 1)) for i in range(k)})
+
+    def next_flush(self, size: int) -> list:
+        """``(sequence, recipient, amount, good_sig)`` rows for one
+        salted flush. Sequences advance monotonically — the honest-
+        looking entries are individually committable, which is exactly
+        what makes the salting adversarial (all-or-nothing admission
+        burns them alongside the poison)."""
+        bad = set(self.positions(size))
+        rows = []
+        for j in range(size):
+            self._seq += 1
+            rows.append(
+                (
+                    self._seq,
+                    self.recipient,
+                    1 + self.rng.randint(0, 9),
+                    j not in bad,
+                )
+            )
+        return rows
+
+
 class HostileFrameGen:
     """Authenticated byzantine peer emitting seeded random frame salvos."""
 

@@ -109,6 +109,8 @@ class SimNet:
         durable: bool = False,
         store_root: Optional[str] = None,
         membership_grace: Optional[float] = None,
+        verifier_mode: str = "auto",
+        rlc_min_batch: int = 128,
         **config_overrides,
     ) -> None:
         self.n = n
@@ -196,11 +198,20 @@ class SimNet:
         self._attest: Dict[tuple, bytes] = {}
         self.attest_violations: List[str] = []
         self._started = False
-        self.verifier = CpuVerifier()
+        # shared across nodes like production; verifier_mode/rlc_min_batch
+        # select the amortized (RLC) path — the salting campaign drops
+        # min_batch so sim-sized admission flushes actually route there
+        self.verifier = CpuVerifier(
+            mode=verifier_mode, rlc_min_batch=rlc_min_batch
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "SimNet":
+        # the net owns the shared verifier, so it warms it (Service.start
+        # only warms verifiers it creates); under the sim scheduler the
+        # executor runs inline, so this is synchronous and deterministic
+        self.loop.run_until_complete(self.verifier.warmup())
         for i in range(self.n):
             self.services.append(self._start_node(i))
         self._started = True
@@ -413,6 +424,50 @@ class SimNet:
         return self.loop.run_until_complete(
             self.asubmit(node, client, sequence, recipient, amount, **kw)
         )
+
+    async def asubmit_batch(
+        self,
+        node: int,
+        client: SignKeyPair,
+        rows,
+        *,
+        source: Optional[str] = None,
+    ) -> Optional[SimRpcError]:
+        """One bulk flush through the real ``SendAssetBatch`` handler —
+        the batch-poisoning campaign's ingress. ``rows`` is a list of
+        ``(sequence, recipient, amount, good_sig)``; a bad row carries a
+        REAL signature with one bit of ``s`` flipped (still decodable
+        and torsion-free, so only the verification equation catches it).
+        Returns None on accept or the ``SimRpcError`` (a salted flush
+        rejecting wholesale is the expected outcome)."""
+        txs = []
+        for sequence, recipient, amount, good_sig in rows:
+            sig = client.sign(
+                transfer_signing_bytes(
+                    client.public, sequence, recipient, amount
+                )
+            )
+            if not good_sig:
+                sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+            txs.append(
+                pb.SendAssetRequest(
+                    sender=client.public,
+                    sequence=sequence,
+                    recipient=recipient,
+                    amount=amount,
+                    signature=sig,
+                )
+            )
+            self.touched.add(recipient)
+        self.touched.add(client.public)
+        ctx = _SimContext(source or f"sim-client-{client.public[:4].hex()}")
+        try:
+            await self.services[node].SendAssetBatch(
+                pb.SendAssetBatchRequest(transactions=txs), ctx
+            )
+            return None
+        except SimRpcError as exc:
+            return exc
 
     async def aregister(self, node: int, pubkey: bytes) -> Optional[int]:
         """Register a client pubkey through the real ``Register`` handler

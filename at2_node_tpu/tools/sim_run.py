@@ -10,7 +10,7 @@ must reproduce it byte-identically on any host (CI gates on this).
 Usage:
     python -m at2_node_tpu.tools.sim_run --seed 1 --episodes 50
         [--nodes 4] [--faults 1] [--hostile 1] [--events 30]
-        [--broker] [--durability] [--minimize]
+        [--broker] [--durability] [--salting] [--minimize]
         [--trace-out results.json] [--quiet]
 
 Exit status: 0 if every episode's invariants held, 1 if any violated
@@ -73,6 +73,12 @@ def main(argv=None) -> int:
                         "cycles, flushes (stale-checkpoint restarts), "
                         "catchup partitions, and membership reconfigs; "
                         "invariants add no-post-restart-equivocation")
+    parser.add_argument("--salting", action="store_true",
+                        help="batch-poisoning campaign: one byzantine "
+                        "client salts bad signatures into bulk flushes "
+                        "while the shared verifier runs amortized (RLC) "
+                        "verification; invariants add bounded "
+                        "amortization loss + router convergence")
     parser.add_argument("--minimize", action="store_true",
                         help="greedily minimize each failing schedule")
     parser.add_argument("--trace-out", metavar="PATH",
@@ -113,6 +119,7 @@ def main(argv=None) -> int:
         progress=progress,
         broker=args.broker,
         durability=args.durability,
+        salting=args.salting,
     )
     campaign["wall_seconds"] = round(time.monotonic() - wall0, 2)
     campaign["argv"] = sys.argv[1:]

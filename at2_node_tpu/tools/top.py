@@ -114,7 +114,7 @@ def render_frame(rows, now: float, prev) -> str:
     cols = (
         f"{'node':<22}{'health':<11}{'tx/s':>8}{'committed':>11}"
         f"{'p50 ms':>9}{'p99 ms':>9}{'dlv p99':>9}{'live tr':>9}"
-        f"{'rej':>6}{'vrf occ':>9}{'q-wait p99':>12}"
+        f"{'rej':>6}{'vrf occ':>9}{'vmode':>10}{'q-wait p99':>12}"
         f"{'backlog':>9}{'dstl rx/ms/dd':>15}{'peers':>7}"
         f"{'epoch':>7}  {'recovery':<16}"
     )
@@ -155,6 +155,7 @@ def render_frame(rows, now: float, prev) -> str:
                 f"{_num(stats, 'broker_batches_tx'):>9}"
                 f"{'':>6}"
                 f"{'-':>9}"
+                f"{'-':>10}"
                 f"{'-':>12}"
                 f"{pend:>9}"
                 f"{drops:>15}"
@@ -176,6 +177,21 @@ def render_frame(rows, now: float, prev) -> str:
             rate = f"{(committed - seen[1]) / (now - seen[0]):.1f}"
         occ = stats.get("verifier_batch_occupancy")
         occ_s = f"{occ:.2f}" if isinstance(occ, float) else "-"
+        # verifier mode + the LIVE routing decision (ISSUE 10):
+        # "auto/rlc" means auto mode whose last flush went amortized;
+        # a trailing ! counts bisection/kernel fallbacks so salting
+        # shows up at a glance
+        routing = sz.get("verifier_routing", {})
+        if routing:
+            vmode_s = (
+                f"{routing.get('mode', '?')[:4]}/"
+                f"{routing.get('route_last', '?')[:3]}"
+            )
+            fb = _num(stats, "verifier_rlc_fallbacks")
+            if fb:
+                vmode_s += f"!{fb}"
+        else:
+            vmode_s = "-"
         qw = vstages.get("queue_wait", {}).get("p99_ms")
         qw_s = f"{qw:.2f}" if isinstance(qw, (int, float)) else "-"
         # broker-ingress tier: distilled batches received / directory
@@ -196,6 +212,7 @@ def render_frame(rows, now: float, prev) -> str:
             f"{_num(lifecycle, 'live_traces'):>9}"
             f"{_num(rej, 'count'):>6}"
             f"{occ_s:>9}"
+            f"{vmode_s:>10}"
             f"{qw_s:>12}"
             f"{_num(stats, 'slots_undelivered'):>9}"
             f"{dstl_s:>15}"

@@ -162,6 +162,31 @@ if [ -z "$r1" ] || [ "$r1" != "$r2" ]; then
 fi
 echo "same-seed restart campaign hash reproduced: $r1"
 
+echo "== amortized-verification gate =="
+# Amortized (RLC) verification (ISSUE 10): first the verdict-agreement
+# suite — RLC verdicts must equal per-sig on every adversarial input
+# class (small-order / mixed-torsion R and A included), bisection must
+# isolate culprits in the expected check counts, and the router policy
+# gates must hold. Then the batch-poisoning campaign — a byzantine
+# client salts bad signatures into bulk flushes while the shared
+# verifier runs amortized — run twice: invariants add bounded
+# amortization loss + router convergence for the salting source, and
+# the campaign hash must reproduce byte-identically (RLC's random
+# coefficients affect internal check counts, never verdicts, so the
+# wire trace is deterministic).
+python -m pytest tests/test_rlc_verify.py -q -m "not slow"
+salting_hash() {
+  python -m at2_node_tpu.tools.sim_run --seed 7 --episodes 3 --salting \
+    --quiet | sed -n 's/.*hash \([0-9a-f]*\).*/\1/p'
+}
+s1="$(salting_hash)"
+s2="$(salting_hash)"
+if [ -z "$s1" ] || [ "$s1" != "$s2" ]; then
+  echo "amortized-verification gate FAILED: '$s1' != '$s2'" >&2
+  exit 1
+fi
+echo "same-seed salting campaign hash reproduced: $s1"
+
 echo "== scenario-grid smoke gate =="
 # Fleet SLO engine + scenario grid (ISSUE 8): the 2x2 smoke slice
 # (lan/wan3 x steady/flash_crowd) must commit every offered transfer,

@@ -1,0 +1,523 @@
+"""Amortized (RLC) verification property tests — ISSUE 10.
+
+Three layers, matching the engine's soundness argument:
+
+* verdict agreement: `RlcEngine.verify_batch` must return EXACTLY the
+  per-signature cofactorless verdicts on every input class, including
+  the adversarial ones (small-order / mixed-torsion R, tainted-A keys
+  whose cofactorless verdict differs from any batched equation);
+* bisection cost: with injected check/leaf functions (no curve work),
+  a planted culprit must be isolated in ~2*log2(B/leaf) extra checks,
+  and the pathological shapes (all-bad, parent-fails-halves-pass)
+  resolve exactly without over-trusting any single check;
+* routing: the VerifyRouter's policy gates and its convergence against
+  a salting source, plus the TpuBatchVerifier capacity invariant when
+  a verify_many caller is cancelled while an RLC flush is resolving.
+
+The TPU-twin graph (`ops.aggregate.rlc_verify_batch`) is exercised in
+the slow tier only: like the aggregate-certificate graph it wraps, its
+triple-table Straus kernel is a minutes-scale XLA compile on CPU
+(tests/test_aggregate.py documents the same split).
+"""
+
+import asyncio
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from at2_node_tpu.crypto.keys import SignKeyPair, verify_one
+from at2_node_tpu.crypto.verifier import (
+    CpuVerifier,
+    RlcEngine,
+    TpuBatchVerifier,
+    VerifyRouter,
+)
+from at2_node_tpu.native.rlc import rlc_available
+from at2_node_tpu.ops import ed25519 as base
+from at2_node_tpu.ops import edwards as ed
+
+requires_rlc = pytest.mark.skipif(
+    not rlc_available(), reason="native rlc library unavailable"
+)
+
+
+def _signed(n, tag=b"rlc"):
+    keys = [SignKeyPair.random() for _ in range(n)]
+    msgs = [tag + b" %d" % i for i in range(n)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    return [(k.public, m, s) for k, m, s in zip(keys, msgs, sigs)]
+
+
+# -- adversarial constructions (same recipe as tests/test_aggregate.py:
+# a signer who KNOWS its scalar plants torsion components) ---------------
+
+
+def _affine_scalar_mult(k, p):
+    acc = (0, 1)
+    while k:
+        if k & 1:
+            acc = ed.affine_add_ints(acc, p)
+        p = ed.affine_add_ints(p, p)
+        k >>= 1
+    return acc
+
+
+def _compress(pt):
+    x, y = pt
+    enc = bytearray(y.to_bytes(32, "little"))
+    if x & 1:
+        enc[31] |= 0x80
+    return bytes(enc)
+
+
+def _torsion_point():
+    for y in range(2, 60):
+        try:
+            x = ed._recover_x(y, 0)
+        except ValueError:
+            continue
+        t = _affine_scalar_mult(base.L, (x, y))
+        if t != (0, 1):
+            return t
+    raise AssertionError("no torsion point found")
+
+
+_BASE_PT = (ed.BX_INT, ed.BY_INT)
+
+
+def _torsioned_r_item(a_scalar, i=0):
+    """Signature whose R carries a small-order component: cofactorless
+    per-sig verification REJECTS ([S]B - R - [h]A == -T != identity)."""
+    torsion = _torsion_point()
+    a_pub = _compress(_affine_scalar_mult(a_scalar, _BASE_PT))
+    msg = b"torsioned R %d" % i
+    r_nonce = 31337 + i
+    r_pt = ed.affine_add_ints(_affine_scalar_mult(r_nonce, _BASE_PT), torsion)
+    r_bytes = _compress(r_pt)
+    h = (
+        int.from_bytes(hashlib.sha512(r_bytes + a_pub + msg).digest(), "little")
+        % base.L
+    )
+    s = (r_nonce + h * a_scalar) % base.L
+    return (a_pub, msg, r_bytes + s.to_bytes(32, "little"))
+
+
+def _tainted_a_item(a_scalar, want_accept):
+    """Signature under a pubkey A' = A + T (torsion in the KEY). The
+    cofactorless residual is -[h]T, so the per-sig verdict depends on
+    h mod ord(T): grinding the message picks acceptance or rejection.
+    Either way the lane must NEVER enter the RLC equation — the engine
+    reroutes it exactly (certification cache)."""
+    torsion = _torsion_point()
+    a_pt = _affine_scalar_mult(a_scalar, _BASE_PT)
+    a_pub = _compress(ed.affine_add_ints(a_pt, torsion))
+    r_nonce = 424242
+    r_bytes = _compress(_affine_scalar_mult(r_nonce, _BASE_PT))
+    for trial in range(256):
+        msg = b"tainted A trial %d" % trial
+        h = (
+            int.from_bytes(
+                hashlib.sha512(r_bytes + a_pub + msg).digest(), "little"
+            )
+            % base.L
+        )
+        s = (r_nonce + h * a_scalar) % base.L
+        item = (a_pub, msg, r_bytes + s.to_bytes(32, "little"))
+        if verify_one(*item) == want_accept:
+            return item
+    raise AssertionError("torsion order exhausted without a matching h")
+
+
+# -- verdict agreement ---------------------------------------------------
+
+
+@requires_rlc
+def test_rlc_verdicts_agree_on_adversarial_matrix():
+    """One batch striping every input class; engine verdicts must equal
+    verify_one lane-for-lane (the ISSUE's core acceptance criterion)."""
+    # 28 lanes: after the invalid + rerouted lanes leave, the RLC-eligible
+    # set stays above the engine's exact-leaf floor (leaf_size=16) so the
+    # amortized check + bisection path actually runs
+    items = _signed(28, b"matrix")
+    pk0, m0, s0 = items[0]
+    items[1] = (items[1][0], items[1][1], items[1][2][:32]
+                + bytes([items[1][2][32] ^ 1]) + items[1][2][33:])  # bad s
+    items[2] = (items[2][0], b"substituted message", items[2][2])
+    # non-canonical s (s + L): host prep must flag it invalid
+    s_int = int.from_bytes(items[3][2][32:], "little")
+    items[3] = (items[3][0], items[3][1],
+                items[3][2][:32] + ((s_int + base.L) % (1 << 256)).to_bytes(32, "little"))
+    items[4] = (items[4][0], items[4][1], b"\xff" * 32 + items[4][2][32:])  # bad R enc
+    items[5] = _torsioned_r_item(987654321987654321987654321 % base.L)
+    items[6] = _tainted_a_item(1122334455667788990 % base.L, want_accept=True)
+    items[7] = _tainted_a_item(998877665544332211 % base.L, want_accept=False)
+
+    expected = [verify_one(pk, m, s) for pk, m, s in items]
+    engine = RlcEngine()
+    got = engine.verify_batch(items)
+    assert got == expected
+    st = engine.stats()
+    assert st["rlc_batches"] == 1
+    # the batch carried culprits, so the single check failed and bisected
+    assert st["rlc_fallbacks"] == 1 and st["rlc_checks"] >= 1
+    # both tainted-A lanes were rerouted — including the ACCEPTING one
+    # (reroute, never reject)
+    assert st["exact_reroutes"] >= 2
+    assert expected[6] is True and got[6] is True
+
+
+@requires_rlc
+def test_rlc_clean_batch_one_check_no_leaves():
+    items = _signed(24, b"clean")
+    engine = RlcEngine()
+    assert engine.verify_batch(items) == [True] * 24
+    st = engine.stats()
+    assert st["rlc_checks"] == 1
+    assert st["rlc_fallbacks"] == 0
+    assert st["leaf_sigs"] == 0
+    assert st["certified_keys"] == 24
+
+
+@requires_rlc
+def test_rlc_small_order_cancellation_pair_rejected():
+    """The test_aggregate cancellation pair (residuals -T, -T built to
+    cancel under chosen coefficients): the engine's RANDOM z and torsion
+    rounds must still reject both lanes, exactly as per-sig does."""
+    torsion = _torsion_point()
+    a_scalar = 987654321987654321987654321 % base.L
+    a_pub = _compress(_affine_scalar_mult(a_scalar, _BASE_PT))
+    attack = []
+    for i, r_nonce in enumerate((11111, 22222)):
+        msg = b"small-order attack %d" % i
+        r_pt = ed.affine_add_ints(
+            _affine_scalar_mult(r_nonce, _BASE_PT), torsion
+        )
+        r_bytes = _compress(r_pt)
+        h = (
+            int.from_bytes(
+                hashlib.sha512(r_bytes + a_pub + msg).digest(), "little"
+            )
+            % base.L
+        )
+        s = (r_nonce + h * a_scalar) % base.L
+        attack.append((a_pub, msg, r_bytes + s.to_bytes(32, "little")))
+    items = attack + _signed(22, b"filler")
+    expected = [verify_one(pk, m, s) for pk, m, s in items]
+    assert expected[:2] == [False, False]
+    assert RlcEngine().verify_batch(items) == expected
+
+
+@requires_rlc
+def test_rlc_cert_cache_hits_across_batches():
+    kp = SignKeyPair.random()
+    engine = RlcEngine()
+    for round_ in range(3):
+        items = [
+            (kp.public, b"round %d msg %d" % (round_, i), None)
+            for i in range(20)
+        ]
+        items = [(pk, m, kp.sign(m)) for pk, m, _ in items]
+        assert engine.verify_batch(items) == [True] * 20
+    st = engine.stats()
+    assert st["certified_keys"] == 1
+    assert st["cert_misses"] == 1  # one exact [L]A, 60 lanes amortized
+
+
+# -- bisection cost (injected checks: counts, not curve work) ------------
+
+
+def _planted_engine(bad, leaf_size=16):
+    def check(prep, idxs):
+        ok = not any(int(i) in bad for i in idxs)
+        return ok, np.ones(len(idxs), dtype=bool)
+
+    def leaf(items, idxs):
+        return [int(i) not in bad for i in idxs]
+
+    return RlcEngine(leaf_size=leaf_size, check_fn=check, leaf_fn=leaf)
+
+
+def test_bisection_isolates_single_culprit_in_log_checks():
+    n = 256
+    items = _signed(n, b"bisect1")
+    engine = _planted_engine({5})
+    got = engine.verify_batch(items)
+    assert got == [i != 5 for i in range(n)]
+    st = engine.stats()
+    # 1 failing batch check + 2 checks per halving level (256 -> 16)
+    levels = 4  # log2(256/16)
+    assert st["rlc_checks"] == 1 + 2 * levels
+    assert st["bisection_depth"] == levels + 1
+    assert st["leaf_sigs"] == 16  # one exact leaf around the culprit
+    assert st["rlc_fallbacks"] == 1
+
+
+def test_bisection_isolates_k_culprits_within_bound():
+    n, bad = 256, {10, 80, 150, 240}
+    items = _signed(n, b"bisectk")
+    engine = _planted_engine(bad)
+    assert engine.verify_batch(items) == [i not in bad for i in range(n)]
+    st = engine.stats()
+    # spread culprits share upper levels; the hard bound is 2k per level
+    assert 1 + 2 * 4 < st["rlc_checks"] <= 1 + 2 * len(bad) * 4
+    assert st["leaf_sigs"] == 16 * len(bad)
+
+
+def test_bisection_all_bad_degrades_to_exact():
+    n = 64
+    items = _signed(n, b"allbad")
+    engine = _planted_engine(set(range(n)))
+    assert engine.verify_batch(items) == [False] * n
+    st = engine.stats()
+    assert st["leaf_sigs"] == n  # every lane resolved exactly
+    assert st["rlc_checks"] == 7  # 1 + 2 (at 64) + 4 (both 32-halves)
+
+
+def test_bisection_parent_fails_halves_pass_anomaly():
+    """A torsion round firing on the parent and missing on both halves
+    must resolve the whole range exactly, not trust either half."""
+    n = 64
+    items = _signed(n, b"anomaly")
+
+    def check(prep, idxs):
+        return len(idxs) < n, np.ones(len(idxs), dtype=bool)
+
+    def leaf(items_, idxs):
+        return [True] * len(idxs)
+
+    engine = RlcEngine(leaf_size=16, check_fn=check, leaf_fn=leaf)
+    assert engine.verify_batch(items) == [True] * n
+    st = engine.stats()
+    assert st["rlc_anomalies"] == 1
+    assert st["leaf_sigs"] == n
+
+
+def test_small_batch_skips_rlc_entirely():
+    items = _signed(12, b"small")
+    engine = _planted_engine({3}, leaf_size=16)
+    assert engine.verify_batch(items) == [i != 3 for i in range(12)]
+    st = engine.stats()
+    assert st["rlc_checks"] == 0  # under the amortization floor
+    assert st["leaf_sigs"] == 12
+
+
+# -- router policy -------------------------------------------------------
+
+
+def test_router_gates_and_forced_modes():
+    srcs = [b"k%d" % i for i in range(16)]
+    r = VerifyRouter("auto", min_batch=8)
+    assert r.choose(srcs) == "rlc"
+    assert r.choose(srcs[:4]) == "per_sig"  # below min_batch
+    assert r.choose(srcs, rlc_ready=False) == "per_sig"  # engine not built
+    assert VerifyRouter("per_sig", min_batch=1).choose(srcs) == "per_sig"
+    assert VerifyRouter("rlc", min_batch=1 << 30).choose(srcs[:2]) == "rlc"
+    with pytest.raises(ValueError):
+        VerifyRouter("both")
+
+
+def test_router_converges_against_salter_and_recovers():
+    r = VerifyRouter("auto", min_batch=8, expected_bad_budget=0.5)
+    salter, honest = b"salter", [b"h%d" % i for i in range(15)]
+    batch = [salter] + honest
+    assert r.choose(batch) == "rlc"
+    # a few salted flushes drive the salter's EWMA over budget
+    for _ in range(5):
+        r.observe([(salter, False)] + [(h, True) for h in honest])
+    assert r.expected_bad(batch) > r.expected_bad_budget
+    assert r.choose(batch) == "per_sig"
+    assert r.hot_sources() == 1
+    # honest-only flushes from other sources still route amortized
+    assert r.choose(honest * 2) == "rlc"
+    # the salter behaving again decays its EWMA back under budget
+    for _ in range(30):
+        r.observe([(salter, True)])
+    assert r.choose(batch) == "rlc"
+    assert r.hot_sources() == 0
+
+
+def test_router_source_table_is_bounded():
+    r = VerifyRouter("auto", max_sources=64)
+    r.observe([(b"s%04d" % i, False) for i in range(500)])
+    assert r.stats()["router_sources"] == 64
+
+
+def test_router_stats_shape():
+    r = VerifyRouter("auto", min_batch=4)
+    r.choose([b"a"] * 8)
+    r.choose([b"a"])
+    st = r.stats()
+    assert st["route_rlc"] == 1 and st["route_per_sig"] == 1
+    assert st["route_last"] == "per_sig" and st["route_last_batch"] == 1
+    assert st["route_rlc_lanes_count"] == 1
+
+
+# -- CpuVerifier integration ---------------------------------------------
+
+
+@requires_rlc
+def test_cpu_verifier_rlc_mode_exact_verdicts():
+    async def run():
+        v = CpuVerifier(mode="rlc", rlc_min_batch=8)
+        await v.warmup()
+        items = _signed(24, b"cpu-rlc")
+        items[7] = (items[7][0], b"tampered", items[7][2])
+        try:
+            got = await v.verify_many(items)
+        finally:
+            await v.close()
+        assert got == [i != 7 for i in range(24)]
+        st = v.stats()
+        assert st["route_rlc"] >= 1 and st["rlc_batches"] >= 1
+        assert st["rlc_fallbacks"] >= 1
+
+    asyncio.run(run())
+
+
+@requires_rlc
+def test_cpu_verifier_auto_flips_to_per_sig_under_salting():
+    async def run():
+        v = CpuVerifier(mode="auto", rlc_min_batch=8)
+        await v.warmup()
+        salter = SignKeyPair.random()
+        try:
+            clean = _signed(16, b"pre-salt")
+            assert await v.verify_many(clean) == [True] * 16
+            assert v.router.last_route == "rlc"
+            # salted flushes: the salter's lane always fails
+            for round_ in range(4):
+                items = _signed(12, b"salt %d" % round_)
+                m = b"salted %d" % round_
+                items.append((salter.public, m, b"\0" * 64))
+                got = await v.verify_many(items)
+                assert got == [True] * 12 + [False]
+            # its EWMA now prices any batch it rides over budget
+            batch_srcs = [it[0] for it in _signed(12, b"x")] + [salter.public]
+            assert (
+                v.router.expected_bad(batch_srcs)
+                > v.router.expected_bad_budget
+            )
+            items = _signed(15, b"post-salt") + [
+                (salter.public, b"again", b"\0" * 64)
+            ]
+            await v.verify_many(items)
+            assert v.router.last_route == "per_sig"
+        finally:
+            await v.close()
+
+    asyncio.run(run())
+
+
+# -- TpuBatchVerifier: capacity safety while an RLC flush resolves -------
+
+
+class _GatedRlcVerifier(TpuBatchVerifier):
+    """Stage-stubbed twin (tests/test_verifier.py idiom): the RLC finish
+    stage blocks on a gate so the test can cancel callers while a flush
+    is mid-resolution."""
+
+    def __init__(self, gate, **kw):
+        super().__init__(**kw)
+        self._gate = gate
+
+    def _prep(self, pks, msgs, sigs, bucket):
+        return len(pks)
+
+    def _launch(self, packed):
+        return packed
+
+    def _finish(self, handle, n):
+        return np.ones(n, dtype=bool)
+
+    def _prep_rlc(self, pks, msgs, sigs, bucket):
+        return len(pks)
+
+    def _launch_rlc(self, packed):
+        return packed
+
+    def _finish_rlc(self, handle, n):
+        self._gate.wait(10.0)
+        return True, np.ones(n, dtype=np.int64)
+
+
+def test_cancelled_verify_many_mid_rlc_releases_capacity():
+    async def run():
+        gate = threading.Event()
+        v = _GatedRlcVerifier(
+            gate,
+            batch_size=8,
+            max_delay=30.0,
+            max_queue=16,
+            mode="rlc",
+            rlc_min_batch=1,
+        )
+        try:
+            # full batch -> immediate flush -> blocks in _finish_rlc
+            inflight = asyncio.create_task(v.verify_many(_signed(8, b"in")))
+            await asyncio.sleep(0.05)
+            assert v.router.last_route == "rlc"
+            # second caller's chunk is UNDER batch_size: it parks in the
+            # accumulator holding reserved capacity
+            parked = asyncio.create_task(v.verify_many(_signed(4, b"park")))
+            await asyncio.sleep(0.05)
+            assert v._cap_free == v.max_queue - 4
+            parked.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await parked
+            # the cancelled caller's reservation is back, with the RLC
+            # flush still mid-resolution
+            assert v._cap_free == v.max_queue
+            assert not gate.is_set()
+            gate.set()
+            assert await inflight == [True] * 8
+            assert v.rlc_batches == 1 and v.rlc_fallbacks == 0
+        finally:
+            gate.set()
+            await v.close()
+        # every pipeline slot drained back
+        assert v._inflight._value == v.PIPELINE_DEPTH
+        assert v._cap_free == v.max_queue
+
+    asyncio.run(run())
+
+
+def test_tpu_auto_default_never_routes_rlc():
+    """On-chip auto keeps the per-sig kernel unless the operator opts in
+    (AGGREGATE_r02: one-MSM certificate shape measured SLOWER than the
+    Pallas per-sig kernel at every banked bucket)."""
+
+    async def run():
+        gate = threading.Event()
+        gate.set()
+        v = _GatedRlcVerifier(gate, batch_size=8, max_delay=0.001, mode="auto")
+        try:
+            assert await v.verify_many(_signed(8, b"auto")) == [True] * 8
+            assert v.rlc_batches == 0
+            assert v.router.route_rlc == 0
+        finally:
+            await v.close()
+
+    asyncio.run(run())
+
+
+# -- TPU-twin graph (slow tier: the triple-table Straus graph is a
+# minutes-scale XLA compile on CPU, same pathology and same tiering as
+# tests/test_aggregate.py) ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_rlc_graph_matches_per_sig_kernel():
+    from at2_node_tpu.ops.aggregate import rlc_verify_batch
+
+    n = 8
+    items = _signed(n, b"twin")
+    items[3] = (items[3][0], b"tampered", items[3][2])
+    items[5] = _torsioned_r_item(555444333222111 % base.L, i=5)
+    pks = [it[0] for it in items]
+    msgs = [it[1] for it in items]
+    sigs = [it[2] for it in items]
+    expected = [verify_one(pk, m, s) for pk, m, s in items]
+    got = rlc_verify_batch(pks, msgs, sigs, n)
+    assert list(np.asarray(got, dtype=bool)) == expected

@@ -1,20 +1,28 @@
 #!/usr/bin/env python3
 """Repo-root shim for the deterministic simulation CLI.
 
-Same interface as ``python -m at2_node_tpu.tools.sim_run`` (the
-canonical home); this wrapper only makes `tools/sim_run.py --seed S
---episodes 50` work from a checkout without installing the package.
+One source of truth: this wrapper re-executes the canonical module
+(``python -m at2_node_tpu.tools.sim_run``) with the checkout on
+PYTHONPATH and the hash seed pinned, so `tools/sim_run.py --seed S`
+works from a checkout without installing the package and without
+duplicating any of the module's argument or re-exec logic here.
 """
 
 import os
 import sys
 
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-
-from at2_node_tpu.tools.sim_run import _pin_hashseed, main  # noqa: E402
-
 if __name__ == "__main__":
-    _pin_hashseed()
-    sys.exit(main())
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        repo + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else repo
+    )
+    # pinned here so the module's own _pin_hashseed re-exec is a no-op
+    env["PYTHONHASHSEED"] = "0"
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "at2_node_tpu.tools.sim_run"] + sys.argv[1:],
+        env,
+    )
