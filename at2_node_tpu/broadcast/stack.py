@@ -403,6 +403,8 @@ class Broadcast:
     # bare objects via __new__ to unit-test single methods) read "no
     # recorder" instead of raising AttributeError
     recorder = None
+    # same contract for the plane time-accounting seam (obs/profiler.py)
+    phases = None
 
     def __init__(
         self,
@@ -416,6 +418,7 @@ class Broadcast:
         trace=None,
         recorder=None,
         clock=None,
+        phases=None,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
 
@@ -522,6 +525,9 @@ class Broadcast:
         # Sites guard with ``is not None`` so the disabled path costs one
         # attribute read.
         self.recorder = recorder
+        # plane time-accounting (obs/profiler.py PhaseAccounting); same
+        # ``is not None`` guard discipline at every marked segment
+        self.phases = phases
         self.registry.gauge(
             "slots_undelivered", "live undelivered broadcast slots",
             fn=lambda: self._undelivered,
@@ -614,6 +620,8 @@ class Broadcast:
         catchup-plane stall signal)."""
         while True:
             await self.clock.sleep(GC_INTERVAL)
+            ph = self.phases
+            t_gc = ph.t() if ph is not None else 0
             now = self.clock.monotonic()
             budget = RETRANSMIT_BUDGET_PER_PASS
             stalled_past_horizon = False
@@ -708,6 +716,8 @@ class Broadcast:
             elif not stalled_past_horizon:
                 # healthy pass: re-arm the hysteresis for the next storm
                 self._stall_backoff = STALL_KICK_MIN_INTERVAL
+            if ph is not None:
+                ph.add("slot_gc", t_gc)
 
     def _resend_slot(
         self, slot: Slot, state: _SlotState, peer: Optional[Peer]
@@ -835,10 +845,21 @@ class Broadcast:
             for _, payload in chunk:
                 if isinstance(payload, (bytes, bytearray, memoryview)):
                     self._inbox_bytes -= len(payload)
+            # plane_total wraps the whole drain cycle (parse + process):
+            # it is the denominator of the per-node plane decomposition
+            # (obs/profiler.py); rx_decode covers the frame parse here,
+            # the admission pre-checks inside _process_chunk chain onto it
+            ph = self.phases
+            t0 = ph.t() if ph is not None else 0
             try:
-                await self._process_chunk(self._parse_chunk(chunk))
+                msgs = self._parse_chunk(chunk)
+                if ph is not None:
+                    ph.add("rx_decode", t0)
+                await self._process_chunk(msgs)
             except Exception:
                 logger.exception("broadcast worker error")
+            if ph is not None:
+                ph.add_ns("plane_total", ph.t() - t0)
 
     def _parse_chunk(self, chunk) -> list:
         """Turn a drained inbox chunk into (peer, message) pairs.
@@ -896,6 +917,8 @@ class Broadcast:
         verify -> sync state transitions (re-validated against races).
         Actions carry how many verify items they claimed: a TxBatch puts
         1 (origin) + count (client) signatures into the SAME bulk call."""
+        ph = self.phases
+        t0 = ph.t() if ph is not None else 0
         to_verify = []
         actions = []  # (kind, msg, n_sigs)
         for peer, msg in chunk:
@@ -954,9 +977,14 @@ class Broadcast:
                 if self._pre_attestation(msg, peer):
                     to_verify.append((msg.origin, msg.to_sign(), msg.signature))
                     actions.append((msg.phase, msg, 1))
+        # admission pre-checks account to rx_decode (receive-side cost)
+        if ph is not None:
+            t0 = ph.add("rx_decode", t0)
         if not to_verify:
             return
         results = await self.verifier.verify_many(to_verify)
+        if ph is not None:
+            ph.add("verify_wait", t0)
         idx = 0
         for kind, msg, n_sigs in actions:
             ok = results[idx]
@@ -1099,6 +1127,8 @@ class Broadcast:
     # re-validated here) ---------------------------------------------------
 
     def _post_gossip(self, payload: Payload) -> None:
+        ph = self.phases
+        t0 = ph.t() if ph is not None else 0
         slot = payload.slot
         if slot in self._delivered_slots:
             return
@@ -1139,9 +1169,15 @@ class Broadcast:
                 self._send_attestation(
                     ECHO, payload.sender, payload.sequence, chash
                 )
+        if ph is not None:
+            t0 = ph.add("echo_apply", t0)
         self._advance(slot, state, chash)
+        if ph is not None:
+            ph.add("ready_deliver", t0)
 
     def _post_attestation(self, att: Attestation) -> None:
+        ph = self.phases
+        t0 = ph.t() if ph is not None else 0
         slot = (att.sender, att.sequence)
         if slot in self._delivered_slots:
             return
@@ -1154,7 +1190,11 @@ class Broadcast:
         by_origin[att.origin] = att.content_hash
         votes = state.echoes if att.phase == ECHO else state.readies
         votes[att.content_hash].add(att.origin)
+        if ph is not None:
+            t0 = ph.add("quorum_bitmap", t0)
         self._advance(slot, state, att.content_hash)
+        if ph is not None:
+            ph.add("ready_deliver", t0)
 
     def _on_request(self, peer: Optional[Peer], req: ContentRequest) -> None:
         """Serve a peer's content pull (no verify: channel-authenticated)."""
@@ -1319,6 +1359,11 @@ class Broadcast:
         return True
 
     def _post_batch(self, batch: TxBatch, entry_oks) -> None:
+        # phase segments are chained (each add() returns the next t0) so
+        # echo_apply / entry_registry / ready_deliver stay disjoint —
+        # their sum never double-counts a nanosecond of this call
+        ph = self.phases
+        t0 = ph.t() if ph is not None else 0
         slot = batch.slot
         if slot in self._delivered_batch_slots:
             return
@@ -1349,6 +1394,8 @@ class Broadcast:
             state.echoed_hash = chash
             bits = 0
             rejected = 0
+            if ph is not None:
+                t0 = ph.add("echo_apply", t0)
             for i, ok in enumerate(entry_oks):
                 if not ok:
                     self.stats["invalid_sig"] += 1
@@ -1366,6 +1413,8 @@ class Broadcast:
                 bits |= 1 << i
                 if self.trace is not None:
                     self.trace.stamp(ekey, "echoed")
+            if ph is not None:
+                t0 = ph.add("entry_registry", t0)
             state.own_echo_bits[chash] = bits
             state.rejected_bits[chash] = rejected
             if self.recorder is not None:
@@ -1377,10 +1426,16 @@ class Broadcast:
                 self._send_batch_attestation(
                     BATCH_ECHO, slot, chash, bits, batch.count
                 )
+        if ph is not None:
+            t0 = ph.add("echo_apply", t0)
         self._advance_batch(slot, state, chash)
         self._maybe_retire_batch(slot, state)
+        if ph is not None:
+            ph.add("ready_deliver", t0)
 
     def _post_batch_attestation(self, att: BatchAttestation) -> None:
+        ph = self.phases
+        t0 = ph.t() if ph is not None else 0
         slot = (att.batch_origin, att.batch_seq)
         if slot in self._delivered_batch_slots:
             return
@@ -1421,8 +1476,14 @@ class Broadcast:
                     return
         if votes.add(att.origin, bits, nbits):
             state.nbits = max(state.nbits, nbits)
+            if ph is not None:
+                t0 = ph.add("quorum_bitmap", t0)
             self._advance_batch(slot, state, att.batch_hash)
             self._maybe_retire_batch(slot, state)
+            if ph is not None:
+                ph.add("ready_deliver", t0)
+        elif ph is not None:
+            ph.add("quorum_bitmap", t0)
 
     def _send_batch_attestation(
         self,

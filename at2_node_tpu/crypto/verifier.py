@@ -668,6 +668,10 @@ class TpuBatchVerifier:
         # explain a latency spike. Duck-typed so the verifier keeps its
         # no-registry, no-obs-import design.
         self.recorder = None
+        # optional plane time-accounting seam (obs/profiler.py), attached
+        # the same duck-typed way: the flush decision is one of the named
+        # serial terms in the per-node plane decomposition.
+        self.phases = None
 
     def stats(self) -> dict:
         """Operator-facing counters: batch occupancy, padding ratio, and
@@ -879,6 +883,8 @@ class TpuBatchVerifier:
                     break
             if not self._queue:
                 continue
+            ph = self.phases
+            t0 = ph.t() if ph is not None else 0
             take = self._take_for_flush()
             if self.recorder is not None:
                 self.recorder.record(
@@ -891,6 +897,10 @@ class TpuBatchVerifier:
             )
             try:
                 await self._release(len(batch))
+                # flush decision only: pipeline latency past this point
+                # is already measured by h_dispatch
+                if ph is not None:
+                    ph.add("verifier_flush", t0)
                 await self._dispatch(batch)
             except BaseException as exc:
                 # once popped from _queue, close()'s sweep can no longer

@@ -112,7 +112,18 @@ class ObservabilityConfig:
     (uncommitted) traces — see obs/trace.py for the eviction policy.
     ``trace_done_cap`` bounds the completed-trace ring served on
     /tracez; ``recorder_cap`` sizes the protocol flight-recorder ring
-    served on /debugz (obs/recorder.py; 0 disables recording)."""
+    served on /debugz (obs/recorder.py; 0 disables recording).
+
+    Continuous profiler (obs/profiler.py, TECHNICAL.md "Continuous
+    profiling & plane time-accounting"): ``profilez`` is the kill-switch
+    for GET /profilez and the healthz degraded-edge stack capture;
+    ``profiler_hz``/``profiler_max_nodes`` size the sampling stack
+    profiler; ``profiler_duration`` is the default capture length for
+    on-demand and edge-triggered captures; ``lag_probe_interval`` paces
+    the event-loop lag probe (0 disables; the standing loop only runs on
+    served nodes — never under sim); ``phase_accounting`` arms the plane
+    time-accounting seam (phase counters accumulate under sim too — they
+    never feed the wire trace)."""
 
     stats_interval: float = 0.0  # seconds between stats lines; 0 = off
     profile_dir: str = ""  # jax.profiler trace output dir; "" = off
@@ -121,6 +132,12 @@ class ObservabilityConfig:
     trace_cap: int = 8192  # max live (uncommitted) traces
     trace_done_cap: int = 1024  # completed traces retained for /tracez
     recorder_cap: int = 2048  # flight-recorder ring size; 0 disables
+    profilez: bool = True  # GET /profilez + degraded-edge capture
+    profiler_hz: float = 97.0  # stack sampler frequency
+    profiler_max_nodes: int = 20000  # stack-tree node budget
+    profiler_duration: float = 10.0  # default capture length, seconds
+    lag_probe_interval: float = 0.05  # event-loop lag probe pace; 0 = off
+    phase_accounting: bool = True  # plane time-accounting seam
 
     def __post_init__(self) -> None:
         if self.trace_sample < 0:
@@ -131,6 +148,14 @@ class ObservabilityConfig:
             raise ValueError("observability.trace_done_cap must be >= 1")
         if self.recorder_cap < 0:
             raise ValueError("observability.recorder_cap must be >= 0")
+        if self.profiler_hz <= 0:
+            raise ValueError("observability.profiler_hz must be > 0")
+        if self.profiler_max_nodes < 1:
+            raise ValueError("observability.profiler_max_nodes must be >= 1")
+        if self.profiler_duration <= 0:
+            raise ValueError("observability.profiler_duration must be > 0")
+        if self.lag_probe_interval < 0:
+            raise ValueError("observability.lag_probe_interval must be >= 0")
 
 
 @dataclass
@@ -369,6 +394,13 @@ class Config:
                 f"trace_cap = {obs.trace_cap}",
                 f"trace_done_cap = {obs.trace_done_cap}",
                 f"recorder_cap = {obs.recorder_cap}",
+                f"profilez = {'true' if obs.profilez else 'false'}",
+                f"profiler_hz = {obs.profiler_hz}",
+                f"profiler_max_nodes = {obs.profiler_max_nodes}",
+                f"profiler_duration = {obs.profiler_duration}",
+                f"lag_probe_interval = {obs.lag_probe_interval}",
+                "phase_accounting = "
+                + ("true" if obs.phase_accounting else "false"),
             ]
         slo = self.slo
         if slo != SloConfig():

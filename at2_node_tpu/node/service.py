@@ -25,6 +25,7 @@ Delivery→commit loop parity (`rpc.rs:149-211`):
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import heapq
 import json
 import logging
@@ -51,6 +52,12 @@ from ..ledger.accounts import AccountModificationError, Accounts
 from ..ledger.recent import RecentTransactions
 from ..net.peers import Mesh, Peer
 from ..net.webmux import PortMux
+from ..obs.profiler import (
+    EventLoopLagProbe,
+    PhaseAccounting,
+    StackSampler,
+    build_info,
+)
 from ..obs.recorder import FlightRecorder
 from ..obs.registry import Registry
 from ..obs.slo import SloEngine, default_objectives
@@ -195,6 +202,31 @@ class Service(At2Servicer):
         )
         self._health_was_ok = True
         self._started_at = self.clock.monotonic()
+        self._started_wall = self.clock.wall()
+        # continuous profiler (obs/profiler.py). Phase accounting is
+        # plain counters/histograms — safe to arm everywhere, sim
+        # included (registry values never feed the wire trace). The
+        # stack sampler is a REAL thread and the lag-probe loop a
+        # standing timer, so neither auto-starts here: the sampler runs
+        # on demand (/profilez?start, the healthz degraded edge, bench
+        # harnesses) and start() spawns the lag loop only on served
+        # (real-time) nodes.
+        self.phases = (
+            PhaseAccounting(self.registry) if obs.phase_accounting else None
+        )
+        self.sampler = StackSampler(
+            hz=obs.profiler_hz, max_nodes=obs.profiler_max_nodes
+        )
+        self.lag_probe = (
+            EventLoopLagProbe(
+                self.registry, self.clock, interval=obs.lag_probe_interval
+            )
+            if obs.lag_probe_interval > 0
+            else None
+        )
+        self._config_hash = hashlib.sha256(
+            config.dumps().encode()
+        ).hexdigest()[:12]
         # SLO engine (obs/slo.py): declarative objectives from the [slo]
         # config table, probed periodically (start() spawns the loop on
         # served nodes), served at GET /sloz, folded into /healthz.
@@ -445,6 +477,7 @@ class Service(At2Servicer):
                     service.recorder if service.recorder.enabled else None
                 ),
                 clock=service.clock,
+                phases=service.phases,
             )
             # flight-record the verifier's flush decisions too (duck-typed
             # attach; a SHARED verifier keeps its first owner's recorder)
@@ -453,6 +486,13 @@ class Service(At2Servicer):
                 and getattr(service.verifier, "recorder", ()) is None
             ):
                 service.verifier.recorder = service.recorder
+            # phase-account the verifier's flush decisions the same way
+            # (a SHARED verifier keeps its first owner's seam)
+            if (
+                service.phases is not None
+                and getattr(service.verifier, "phases", ()) is None
+            ):
+                service.verifier.phases = service.phases
             service.broadcast.catchup_handler = service._on_catchup
             service.broadcast.directory_handler = service._on_directory
             if service.store is not None:
@@ -543,6 +583,12 @@ class Service(At2Servicer):
                 service._slo_task = asyncio.create_task(
                     service._slo_loop(config.slo.probe_interval)
                 )
+            # event-loop lag probe loop: served nodes only, same reasoning
+            # as the SLO probe (a standing timer under sim virtual time
+            # would blunt SimScheduler's deadlock detection; sim tests
+            # drive probe_once() manually instead)
+            if serve_rpc and service.lag_probe is not None:
+                service.lag_probe.start()
             if obs.profile_dir:
                 import jax
 
@@ -610,6 +656,9 @@ class Service(At2Servicer):
                 await self._slo_task
             except asyncio.CancelledError:
                 pass
+        if self.lag_probe is not None:
+            await self.lag_probe.stop()
+        self.sampler.stop()
         if self._checkpoint_task is not None:
             self._checkpoint_task.cancel()
             try:
@@ -1014,7 +1063,77 @@ class Service(At2Servicer):
                 self.sloz(), sort_keys=True, default=float
             ).encode()
             return 200, self._OBS_JSON, body
+        if route == "/profilez":
+            # [observability] kill-switch, same contract as the other
+            # gated surfaces: switched off means 404, not 403 — the
+            # endpoint does not exist on this node
+            if not self.config.observability.profilez:
+                return None
+            params: dict[str, str] = {}
+            for part in query.split("&"):
+                if part:
+                    k, _, v = part.partition("=")
+                    params[k] = v
+            return self.profilez(params)
         return None
+
+    def profilez(self, params: dict | None = None):
+        """GET /profilez: the sampling profiler's control + view surface.
+
+        ``?start[&duration=S]`` resets the tree and begins a bounded
+        capture (default length [observability] profiler_duration);
+        ``?stop`` ends one early; ``?fmt=folded[&limit=N]`` serves
+        collapsed-stack text for flamegraph tooling; the default GET
+        serves JSON — sampler state, the stack tree, folded lines, the
+        build block, and the phase-accounting totals (so one scrape
+        carries the whole plane decomposition input)."""
+        params = params or {}
+        obs = self.config.observability
+        if "start" in params:
+            try:
+                duration = float(
+                    params.get("duration") or obs.profiler_duration
+                )
+            except ValueError:
+                duration = obs.profiler_duration
+            self.sampler.reset()
+            started = self.sampler.start(duration=duration)
+            body = json.dumps(
+                {"started": started, **self.sampler.stats()},
+                sort_keys=True, default=float,
+            ).encode()
+            return 200, self._OBS_JSON, body
+        if "stop" in params:
+            self.sampler.stop()
+            body = json.dumps(
+                {"stopped": True, **self.sampler.stats()},
+                sort_keys=True, default=float,
+            ).encode()
+            return 200, self._OBS_JSON, body
+        limit = None
+        if "limit" in params:
+            try:
+                limit = max(0, int(params["limit"]))
+            except ValueError:
+                pass
+        if params.get("fmt") == "folded":
+            body = self.sampler.folded(limit).encode()
+            return 200, "text/plain; charset=utf-8", body
+        folded = self.sampler.folded(limit)
+        body = json.dumps(
+            {
+                "node": self.config.sign_key.public.hex()[:16],
+                "build": self.build_block(),
+                "sampler": self.sampler.stats(),
+                "phases": (
+                    self.phases.totals() if self.phases is not None else {}
+                ),
+                "folded": folded.splitlines(),
+                "tree": self.sampler.tree(),
+            },
+            sort_keys=True, default=float,
+        ).encode()
+        return 200, self._OBS_JSON, body
 
     def tracez(self, limit: int | None = None) -> dict:
         """Live + completed lifecycle traces plus a paired clock reading
@@ -1085,6 +1204,20 @@ class Service(At2Servicer):
             else:
                 reason = "slo:" + ",".join(slo_breach)
             self.recorder.snapshot("healthz_degraded:" + reason)
+            # same edge, stack capture: one bounded profiler run per
+            # incident, so the burn that degraded the node is
+            # attributable from /profilez afterwards. Served nodes only
+            # (the sampler is a real thread — never auto-started under
+            # sim) and never clobbering an operator-started capture.
+            if (
+                self.config.observability.profilez
+                and self._mux is not None
+                and not self.sampler.running
+            ):
+                self.sampler.reset()
+                self.sampler.start(
+                    duration=self.config.observability.profiler_duration
+                )
         self._health_was_ok = ok
         if not ok:
             status = "degraded"
@@ -1105,6 +1238,18 @@ class Service(At2Servicer):
             "pending": len(self._heap),
             "committed": self.committed,
             "uptime_s": round(now - self._started_at, 3),
+        }
+
+    def build_block(self) -> dict:
+        """The /statusz ``build`` block: exactly what is running — the
+        static identity (git SHA, Python/JAX versions) plus this
+        process's config hash, start time, and uptime. profile_collect
+        and regress.py stamp their reports with the static half."""
+        return {
+            **build_info(),
+            "config_hash": self._config_hash,
+            "started_wall": round(self._started_wall, 3),
+            "uptime_s": round(self.clock.monotonic() - self._started_at, 3),
         }
 
     def statusz(self) -> dict:
@@ -1129,6 +1274,7 @@ class Service(At2Servicer):
         return {
             "node": self.config.sign_key.public.hex()[:16],
             "rpc_address": self.config.rpc_address,
+            "build": self.build_block(),
             "health": self.health_verdict(),
             "stats": self.snapshot_stats(),
             "tx_lifecycle": self.tx_trace.snapshot(),
@@ -1369,6 +1515,8 @@ class Service(At2Servicer):
         """Post-apply commit bookkeeping, always run to completion (the
         caller shields it): history retention, counters, equivocation-
         registry release, and the recent-ring flips."""
+        ph = self.phases
+        t0 = ph.t() if ph is not None else 0
         for key, payload, s_bal, r_bal in commits:
             logger.info(
                 "new payload: seq=%d sender=%s",
@@ -1401,6 +1549,8 @@ class Service(At2Servicer):
                 self.broadcast.release_entry(payload.sender, payload.sequence)
         if ring_ops:
             await self.recent.apply_many(ring_ops)
+        if ph is not None:
+            ph.add("commit_tail", t0)
 
     # -- ledger-history catchup ------------------------------------------
     #

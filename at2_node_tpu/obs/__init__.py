@@ -4,6 +4,14 @@ registry.py / trace.py / recorder.py / slo.py module docstrings and the
 TECHNICAL.md "Observability" and "Fleet tracing & flight recorder"
 sections for the contracts."""
 
+from .profiler import (
+    PHASES,
+    PLANE_LEAF_PHASES,
+    EventLoopLagProbe,
+    PhaseAccounting,
+    StackSampler,
+    build_info,
+)
 from .recorder import FlightRecorder
 from .registry import (
     Counter,
@@ -19,15 +27,20 @@ __all__ = [
     "BROKER_STAGES",
     "Counter",
     "CounterGroup",
+    "EventLoopLagProbe",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "Objective",
+    "PHASES",
+    "PLANE_LEAF_PHASES",
+    "PhaseAccounting",
     "REJECTED",
     "Registry",
     "STAGES",
     "SloEngine",
+    "StackSampler",
     "TxTrace",
-    "default_objectives",
+    "build_info",
     "evaluate_point",
 ]

@@ -37,6 +37,7 @@ import time
 from ..broadcast.messages import Payload, TxBatch
 from ..crypto.keys import SignKeyPair
 from ..node.config import ObservabilityConfig, SloConfig, VerifierConfig
+from ..obs.profiler import PLANE_LEAF_PHASES
 from ..node.service import Service
 from ..types import ThinTransaction
 from ._common import make_net_configs, port_counter
@@ -74,7 +75,7 @@ class _TrustAllVerifier:
 
 async def run(
     nodes: int, txs: int, verifier: str, timeout: float, batch: int = 0,
-    obs: bool = True,
+    obs: bool = True, profile: bool = False, linger: float = 0.0,
 ) -> dict:
     plane_only = verifier == "plane-only"
     cfgs = make_net_configs(
@@ -84,7 +85,13 @@ async def run(
         observability=(
             ObservabilityConfig()
             if obs
-            else ObservabilityConfig(trace_sample=0, recorder_cap=0)
+            # the off arm zeroes EVERY observability seam, the profiler
+            # tier included: no lifecycle tracer, no flight recorder, no
+            # phase accounting, no lag probe, no /profilez
+            else ObservabilityConfig(
+                trace_sample=0, recorder_cap=0, profilez=False,
+                lag_probe_interval=0.0, phase_accounting=False,
+            )
         ),
         # the off arm silences the SLO probe loop too: "obs off" means
         # every periodic observability task, not just the tracer
@@ -110,6 +117,13 @@ async def run(
                 raw = b"".join(p.encode()[1:] for p in payloads[i : i + batch])
                 batches.append(TxBatch.create(node_key, i + 1, raw))
 
+        if profile and obs:
+            # one sampler, node 0's: in deployment each node-process
+            # runs one sampler over its own threads; here one sampler
+            # walks ALL the in-process nodes' threads, which already
+            # costs at least what a single node pays
+            services[0].sampler.start()
+
         # this tool IS the ingress (it bypasses the RPC surface), so it
         # stamps the tracer itself — the latency block below then carries
         # real ingress->commit percentiles for the firehose
@@ -129,6 +143,19 @@ async def run(
                 timed_out = True
                 break
         dt = time.perf_counter() - t0
+        if linger:
+            # keep the fleet alive past periodic maintenance (slot GC
+            # fires every GC_INTERVAL=5s) so those phase counters tick
+            await asyncio.sleep(linger)
+        prof = None
+        if profile and obs:
+            services[0].sampler.stop()
+            folded = services[0].sampler.folded().splitlines()
+            prof = {
+                "samples": services[0].sampler.stats()["samples"],
+                "folded_lines": len(folded),
+                "top_folded": folded[:5],
+            }
         committed = [s.committed for s in services]
         stats = services[0].snapshot_stats()
         vstats = {
@@ -143,6 +170,7 @@ async def run(
             "verifier": verifier,
             "batch": batch,
             "obs": obs,
+            "profiler": prof,
             "submitted": txs,
             "committed_per_node": committed,
             "seconds": round(dt, 3),
@@ -190,10 +218,15 @@ def compare_obs(
     to read a noisy 1-core host, the fastest run is the least-perturbed
     one — and check the on-arm's regression against the budget."""
     arms: dict = {"on": [], "off": []}
+    samples = 0
     for _ in range(repeat):
         for obs in (True, False):
+            # the measured arm carries the FULL observability tier:
+            # tracer, recorder, SLO probes, phase accounting, the
+            # event-loop lag probe, and a live stack sampler
             res = asyncio.run(
-                run(nodes, txs, verifier, timeout, batch, obs=obs)
+                run(nodes, txs, verifier, timeout, batch, obs=obs,
+                    profile=obs)
             )
             if res["timed_out"]:
                 raise RuntimeError(
@@ -201,6 +234,8 @@ def compare_obs(
                     "no measurement"
                 )
             arms["on" if obs else "off"].append(res["committed_tx_per_sec"])
+            if res["profiler"]:
+                samples += res["profiler"]["samples"]
     best_on, best_off = max(arms["on"]), max(arms["off"])
     overhead_pct = (
         round(100.0 * (1.0 - best_on / best_off), 2) if best_off else 0.0
@@ -214,11 +249,53 @@ def compare_obs(
         "repeat": repeat,
         "rates_on": arms["on"],
         "rates_off": arms["off"],
+        "sampler_samples_on": samples,
         "best_on_tx_per_sec": best_on,
         "best_off_tx_per_sec": best_off,
         "overhead_pct": overhead_pct,
         "budget_pct": budget_pct,
         "ok": overhead_pct <= budget_pct,
+    }
+
+
+# every phase account a cpu-verifier batched run can exercise:
+# the six plane leaves (entry_registry needs --batch >= 1), the
+# per-worker plane total, the commit tail, and slot GC (the smoke run
+# lingers past GC_INTERVAL=5s so it ticks). verifier_flush is a
+# TpuBatchVerifier account and stays zero under the cpu verifier.
+_SMOKE_PHASES = PLANE_LEAF_PHASES + ("plane_total", "commit_tail", "slot_gc")
+
+
+def smoke_profile(nodes: int, txs: int, timeout: float) -> dict:
+    """The CI profiler smoke (ISSUE 11): one short batched firehose with
+    the sampler live, then assert the capture produced folded stacks and
+    every exercisable phase counter actually ticked."""
+    res = asyncio.run(
+        run(nodes, txs, "cpu", timeout, batch=16, obs=True,
+            profile=True, linger=5.5)
+    )
+    stats = res["node0_stats"]
+    zero = [p for p in _SMOKE_PHASES if not stats.get(f"phase_{p}_ns", 0)]
+    prof = res["profiler"] or {}
+    ok = (
+        bool(prof.get("folded_lines"))
+        and not zero
+        and not res["timed_out"]
+    )
+    return {
+        "config": "profiler smoke (batched firehose, sampler live)",
+        "nodes": nodes,
+        "submitted": txs,
+        "timed_out": res["timed_out"],
+        "committed_tx_per_sec": res["committed_tx_per_sec"],
+        "samples": prof.get("samples", 0),
+        "folded_lines": prof.get("folded_lines", 0),
+        "top_folded": prof.get("top_folded", []),
+        "phase_ns": {
+            p: stats.get(f"phase_{p}_ns", 0) for p in _SMOKE_PHASES
+        },
+        "zero_phases": zero,
+        "ok": ok,
     }
 
 
@@ -245,9 +322,16 @@ def main(argv=None) -> int:
     ap.add_argument("--budget", type=float, default=5.0,
                     help="with --compare-obs: max tolerated overhead %% "
                          "(default 5)")
+    ap.add_argument("--smoke-profile", action="store_true",
+                    help="CI profiler smoke: one short batched run with "
+                         "the sampler live; nonzero exit unless folded "
+                         "stacks came back and every exercisable phase "
+                         "counter ticked")
     ap.add_argument("--out", default="-")
     args = ap.parse_args(argv)
-    if args.compare_obs:
+    if args.smoke_profile:
+        result = smoke_profile(args.nodes, args.txs, args.timeout)
+    elif args.compare_obs:
         result = compare_obs(
             args.nodes, args.txs, args.verifier, args.timeout, args.batch,
             args.repeat, args.budget,
@@ -264,6 +348,14 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             f.write(blob)
         print(f"wrote {args.out}", file=sys.stderr)
+    if args.smoke_profile and not result["ok"]:
+        print(
+            "profiler smoke failed: "
+            + (f"zero phase counters {result['zero_phases']}"
+               if result["zero_phases"] else "no folded stacks captured"),
+            file=sys.stderr,
+        )
+        return 1
     if args.compare_obs and not result["ok"]:
         print(
             f"observability overhead {result['overhead_pct']}% exceeds "

@@ -1,0 +1,518 @@
+"""Bench-regression sentry: schema-checked loaders for every banked
+benchmark artifact, cross-capture diffs with noise bands, and a nonzero
+exit on regression — the CI gate that stops a PR from silently losing
+the 12.2k-tx/s ingress or 25.0k-sigs/s RLC wins.
+
+    python -m at2_node_tpu.tools.regress [--dir .] [--band 0.15]
+
+Rules (TECHNICAL.md "Continuous profiling & plane time-accounting"):
+
+* Every artifact family has a loader that REQUIRES its schema — a
+  malformed or truncated bank file exits 2 and names the missing key.
+* Rows diff only against COMPARABLE history: a row's comparability key
+  carries its ``tunnel_live_at_write`` / device state, so a cpu-fallback
+  capture is never judged against a live-chip one (and vice versa); the
+  nearest earlier capture with a matching key is the baseline.
+* A drop beyond ``--band`` (default 15%, scheduler-noise headroom) in
+  the good direction (throughput down, latency up) is a REGRESSION and
+  the exit code is 1. Improvements and in-band noise pass.
+* Output is a deterministic trajectory table: no wall timestamps, rows
+  sorted, floats fixed-format — two runs over the same artifacts are
+  byte-identical (the CI determinism contract every other gate in this
+  repo already follows). The report is stamped with the artifact-set
+  fingerprint plus the STATIC build identity (git SHA, Python/JAX
+  versions) from obs.profiler.build_info.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+from typing import Iterable, List, Optional
+
+from ..obs.profiler import build_info
+
+DEFAULT_BAND = 0.15
+
+# artifact families with per-run capture files: NAME_r<N>.json
+_RUN_RE = re.compile(r"^(BENCH|SCALE|MULTICHIP)_r(\d+)\.json$")
+# single-file families (may hold multi-capture series internally)
+_SINGLE_FILES = (
+    "BENCH_LASTGOOD.json",
+    "BENCH_AGGREGATE.json",
+    "BENCH_PIPELINE.json",
+    "BENCH_E2E.json",
+    "BENCH_DURABILITY.json",
+    "BENCH_SCENARIOS.json",
+    "BENCH_OBS_OVERHEAD.json",
+)
+
+
+class SchemaError(ValueError):
+    """A banked artifact violates its family schema."""
+
+
+def _require(doc, key: str, path: str, typ=None):
+    if not isinstance(doc, dict):
+        raise SchemaError(f"{path}: expected object, got {type(doc).__name__}")
+    if key not in doc:
+        raise SchemaError(f"{path}: missing required key {key!r}")
+    v = doc[key]
+    if typ is not None and not isinstance(v, typ):
+        raise SchemaError(
+            f"{path}.{key}: expected {typ.__name__ if not isinstance(typ, tuple) else '/'.join(t.__name__ for t in typ)},"
+            f" got {type(v).__name__}"
+        )
+    return v
+
+
+def _num(doc, key: str, path: str) -> float:
+    v = _require(doc, key, path)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(f"{path}.{key}: expected number, got {type(v).__name__}")
+    return float(v)
+
+
+# A row is one (series, capture) measurement:
+#   {"series", "capture", "order", "value", "comparable", "lower_better"}
+# ``comparable`` is the tunnel/device/config state two captures must
+# share to be judged against each other.
+
+
+def _row(series, capture, order, value, comparable, lower_better=False):
+    return {
+        "series": series,
+        "capture": capture,
+        "order": order,
+        "value": float(value),
+        "comparable": comparable,
+        "lower_better": lower_better,
+    }
+
+
+def _tunnel_tag(*scopes) -> str:
+    """Comparability fragment from the first scope that carries the
+    tunnel flag; an absent flag is its own state (legacy captures only
+    compare to other legacy captures)."""
+    for scope in scopes:
+        if isinstance(scope, dict) and "tunnel_live_at_write" in scope:
+            return f"tunnel={scope['tunnel_live_at_write']}"
+    return "tunnel=unknown"
+
+
+# -- per-family loaders ----------------------------------------------------
+
+
+def load_bench_run(name: str, doc: dict, order: int) -> List[dict]:
+    parsed = _require(doc, "parsed", name, dict)
+    _require(doc, "rc", name)
+    _require(doc, "cmd", name)
+    metric = _require(parsed, "metric", f"{name}.parsed", str)
+    _require(parsed, "unit", f"{name}.parsed", str)
+    value = _num(parsed, "value", f"{name}.parsed")
+    comp = f"device={parsed.get('device')} {_tunnel_tag(parsed)}"
+    cap = name[len("BENCH_") : -len(".json")]
+    rows = [_row(f"bench/{metric}", cap, order, value, comp)]
+    grid = parsed.get("grid")
+    if grid is not None:
+        if not isinstance(grid, dict):
+            raise SchemaError(f"{name}.parsed.grid: expected object")
+        for bucket in grid:
+            cell = _require(grid, bucket, f"{name}.parsed.grid", dict)
+            for kind in ("device_only", "pipelined"):
+                rows.append(
+                    _row(
+                        f"bench/grid.{bucket}.{kind}",
+                        cap,
+                        order,
+                        _num(cell, kind, f"{name}.parsed.grid.{bucket}"),
+                        f"device={parsed.get('device')} "
+                        + _tunnel_tag(cell, parsed),
+                    )
+                )
+    return rows
+
+
+def load_scale_run(name: str, doc: dict, order: int) -> List[dict]:
+    net = _require(doc, "net", name, dict)
+    _require(doc, "replay", name, dict)
+    for key in ("nodes", "clients", "submitted", "committed"):
+        _num(net, key, f"{name}.net")
+    cap = name[len("SCALE_") : -len(".json")]
+    comp = (
+        f"nodes={int(net['nodes'])} clients={int(net['clients'])} "
+        f"submitted={int(net['submitted'])}"
+    )
+    rows = [
+        _row(
+            "scale/net.committed_tx_per_sec",
+            cap,
+            order,
+            _num(net, "committed_tx_per_sec", f"{name}.net"),
+            comp,
+        )
+    ]
+    if "commit_seconds" in net:
+        rows.append(
+            _row(
+                "scale/net.commit_seconds",
+                cap,
+                order,
+                _num(net, "commit_seconds", f"{name}.net"),
+                comp,
+                lower_better=True,
+            )
+        )
+    return rows
+
+
+def load_multichip_run(name: str, doc: dict, order: int) -> List[dict]:
+    _require(doc, "n_devices", name)
+    _require(doc, "rc", name)
+    _require(doc, "ok", name)
+    skipped = _require(doc, "skipped", name)
+    # a skipped capture (no chip answered) banks provenance, not data
+    del order, skipped
+    return []
+
+
+def load_aggregate(name: str, doc: dict) -> List[dict]:
+    _require(doc, "config", name)
+    runs = _require(doc, "runs", name, dict)
+    _require(doc, "latest", name, str)
+    rows: List[dict] = []
+    for order, cap in enumerate(sorted(runs)):
+        run = _require(runs, cap, f"{name}.runs", dict)
+        grid = _require(run, "grid", f"{name}.runs.{cap}", list)
+        for cell in grid:
+            path = f"{name}.runs.{cap}.grid[]"
+            batch = int(_num(cell, "batch", path))
+            fail = _num(cell, "failure_rate", path)
+            comp = _tunnel_tag(cell, run)
+            for metric in ("rlc_sigs_per_sec", "per_sig_native_sigs_per_sec"):
+                rows.append(
+                    _row(
+                        f"aggregate/batch{batch}.fail{fail:g}.{metric}",
+                        cap,
+                        order,
+                        _num(cell, metric, path),
+                        comp,
+                    )
+                )
+    return rows
+
+
+def load_pipeline(name: str, doc: dict) -> List[dict]:
+    vg = _require(doc, "verify_grid", name, dict)
+    grid = _require(vg, "grid", f"{name}.verify_grid", dict)
+    rows: List[dict] = []
+    for bucket in grid:
+        cell = _require(grid, bucket, f"{name}.verify_grid.grid", dict)
+        comp = f"device={vg.get('device')} {_tunnel_tag(cell, vg)}"
+        for kind in ("device_only", "pipelined"):
+            rows.append(
+                _row(
+                    f"pipeline/grid.{bucket}.{kind}",
+                    "current",
+                    0,
+                    _num(cell, kind, f"{name}.verify_grid.grid.{bucket}"),
+                    comp,
+                )
+            )
+    plane = doc.get("plane")
+    if isinstance(plane, dict) and "committed_tx_per_sec" in plane:
+        rows.append(
+            _row(
+                "pipeline/plane.committed_tx_per_sec",
+                "current",
+                0,
+                _num(plane, "committed_tx_per_sec", f"{name}.plane"),
+                f"nodes={plane.get('nodes')} {_tunnel_tag(plane)}",
+            )
+        )
+    return rows
+
+
+def load_lastgood(name: str, doc: dict) -> List[dict]:
+    metric = _require(doc, "metric", name, str)
+    value = _num(doc, "value", name)
+    comp = f"device={doc.get('device')} {_tunnel_tag(doc)}"
+    return [_row(f"lastgood/{metric}", "lastgood", 0, value, comp)]
+
+
+def load_e2e(name: str, doc: dict) -> List[dict]:
+    _require(doc, "config", name)
+    rows: List[dict] = []
+    ingress = doc.get("ingress_decomposition")
+    if isinstance(ingress, dict):
+        distilled = _require(ingress, "distilled", f"{name}.ingress_decomposition", dict)
+        rows.append(
+            _row(
+                "e2e/ingress.distilled_tx_per_sec",
+                "current",
+                0,
+                _num(distilled, "ingress_tx_per_sec", f"{name}.ingress_decomposition.distilled"),
+                _tunnel_tag(ingress, doc) + " crypto_free=True",
+            )
+        )
+    floor = doc.get("crypto_floor_rlc")
+    if isinstance(floor, dict):
+        comp = _tunnel_tag(floor, doc) + f" bucket={floor.get('bucket')}"
+        rows.append(
+            _row(
+                "e2e/crypto_floor.rlc_sigs_per_sec",
+                "current",
+                0,
+                _num(floor, "rlc_sigs_per_sec", f"{name}.crypto_floor_rlc"),
+                comp,
+            )
+        )
+    return rows
+
+
+def load_durability(name: str, doc: dict) -> List[dict]:
+    _require(doc, "accounts", name)
+    _require(doc, "ok", name)
+    comp = f"accounts={doc['accounts']} shards={doc.get('shards')}"
+    rows = [
+        _row("durability/migrate_s", "current", 0,
+             _num(doc, "migrate_s", name), comp, lower_better=True),
+    ]
+    restart = doc.get("service_restart")
+    if isinstance(restart, dict):
+        rows.append(
+            _row(
+                "durability/restart.healthy_after_s",
+                "current",
+                0,
+                _num(restart, "healthy_after_s", f"{name}.service_restart"),
+                comp,
+                lower_better=True,
+            )
+        )
+    return rows
+
+
+def load_scenarios(name: str, doc: dict) -> List[dict]:
+    cells = _require(doc, "cells", name, list)
+    _require(doc, "grid_hash", name, str)
+    rows: List[dict] = []
+    for i, cell in enumerate(cells):
+        path = f"{name}.cells[{i}]"
+        comp = (
+            f"nodes={cell.get('nodes')} faults={cell.get('faults')} "
+            f"offered={cell.get('offered')}"
+        )
+        rows.append(
+            _row(
+                f"scenarios/cell{i}.latency_p99_ms",
+                "current",
+                0,
+                _num(cell, "latency_p99_ms", path),
+                comp,
+                lower_better=True,
+            )
+        )
+    return rows
+
+
+def load_obs_overhead(name: str, doc: dict) -> List[dict]:
+    _require(doc, "config", name)
+    _num(doc, "overhead_pct", name)
+    _num(doc, "budget_pct", name)
+    comp = (
+        f"nodes={doc.get('nodes')} batch={doc.get('batch')} "
+        f"submitted={doc.get('submitted')}"
+    )
+    # the on-arm throughput is the tracked series (overhead_pct hovers
+    # around zero, where percent-delta judging is ill-conditioned; the
+    # <budget assertion itself lives in the plane_bench CI gate)
+    return [
+        _row(
+            "obs/best_on_tx_per_sec",
+            "current",
+            0,
+            _num(doc, "best_on_tx_per_sec", name),
+            comp,
+        )
+    ]
+
+
+_SINGLE_LOADERS = {
+    "BENCH_LASTGOOD.json": load_lastgood,
+    "BENCH_AGGREGATE.json": load_aggregate,
+    "BENCH_PIPELINE.json": load_pipeline,
+    "BENCH_E2E.json": load_e2e,
+    "BENCH_DURABILITY.json": load_durability,
+    "BENCH_SCENARIOS.json": load_scenarios,
+    "BENCH_OBS_OVERHEAD.json": load_obs_overhead,
+}
+
+_RUN_LOADERS = {
+    "BENCH": load_bench_run,
+    "SCALE": load_scale_run,
+    "MULTICHIP": load_multichip_run,
+}
+
+
+# -- scanning + judging ----------------------------------------------------
+
+
+def scan(directory: str) -> tuple[List[dict], List[str], str]:
+    """Load every recognized artifact under ``directory``. Returns
+    (rows, loaded file names, artifact-set fingerprint)."""
+    rows: List[dict] = []
+    loaded: List[str] = []
+    digest = hashlib.sha256()
+    for name in sorted(os.listdir(directory)):
+        m = _RUN_RE.match(name)
+        loader = None
+        if m is not None:
+            family, order = m.group(1), int(m.group(2))
+            loader = lambda n, d, f=family, o=order: _RUN_LOADERS[f](n, d, o)
+        elif name in _SINGLE_LOADERS:
+            loader = _SINGLE_LOADERS[name]
+        if loader is None:
+            continue
+        path = os.path.join(directory, name)
+        raw = open(path, "rb").read()
+        digest.update(name.encode())
+        digest.update(raw)
+        try:
+            doc = json.loads(raw)
+        except ValueError as exc:
+            raise SchemaError(f"{name}: invalid JSON ({exc})") from exc
+        rows.extend(loader(name, doc))
+        loaded.append(name)
+    return rows, loaded, digest.hexdigest()[:12]
+
+
+def judge(rows: Iterable[dict], band: float) -> List[dict]:
+    """One verdict per multi-capture series: the LATEST capture against
+    the nearest earlier capture with a matching comparability key."""
+    series: dict[str, List[dict]] = {}
+    for r in rows:
+        series.setdefault(r["series"], []).append(r)
+    verdicts: List[dict] = []
+    for key in sorted(series):
+        caps = sorted(series[key], key=lambda r: (r["order"], r["capture"]))
+        if len(caps) < 2:
+            continue
+        latest = caps[-1]
+        baseline = None
+        for prior in reversed(caps[:-1]):
+            if prior["comparable"] == latest["comparable"]:
+                baseline = prior
+                break
+        entry = {
+            "series": key,
+            "trajectory": [(c["capture"], c["value"]) for c in caps],
+            "latest": latest,
+        }
+        if baseline is None:
+            entry["verdict"] = "no_comparable_baseline"
+            entry["delta_pct"] = None
+        else:
+            prev, cur = baseline["value"], latest["value"]
+            entry["baseline"] = baseline
+            if prev == 0:
+                delta = 0.0
+            elif latest["lower_better"]:
+                delta = (prev - cur) / prev  # positive = improved
+            else:
+                delta = (cur - prev) / prev
+            entry["delta_pct"] = delta * 100.0
+            entry["verdict"] = "REGRESSION" if delta < -band else "ok"
+        verdicts.append(entry)
+    return verdicts
+
+
+def _fmt_v(v: float) -> str:
+    return f"{v:.1f}"
+
+
+def render(
+    verdicts: List[dict],
+    rows: List[dict],
+    loaded: List[str],
+    fingerprint: str,
+    band: float,
+) -> str:
+    info = build_info()
+    out = [
+        "== bench-regression sentry ==",
+        (
+            f"stamp {fingerprint}  git {info['git_sha'] or 'unknown'}  "
+            f"python {info['python']}  jax {info['jax'] or 'none'}  "
+            f"band {band * 100:.1f}%"
+        ),
+        f"artifacts: {len(loaded)} files, {len(rows)} rows, "
+        f"{len({r['series'] for r in rows})} series, "
+        f"{len(verdicts)} multi-capture series judged",
+        "",
+    ]
+    if verdicts:
+        width = max(len(v["series"]) for v in verdicts) + 2
+        for v in verdicts:
+            traj = " -> ".join(
+                f"{cap}:{_fmt_v(val)}" for cap, val in v["trajectory"]
+            )
+            if v["delta_pct"] is None:
+                tail = "skipped (no comparable baseline: "
+                tail += v["latest"]["comparable"] + ")"
+            else:
+                base = v["baseline"]
+                tail = (
+                    f"{v['verdict']} ({v['delta_pct']:+.1f}% vs "
+                    f"{base['capture']})"
+                )
+            out.append(f"{v['series']:<{width}}{traj}")
+            out.append(f"{'':<{width}}{tail}")
+    else:
+        out.append("(no multi-capture series to judge)")
+    regressions = [v for v in verdicts if v["verdict"] == "REGRESSION"]
+    out.append("")
+    if regressions:
+        out.append(f"REGRESSIONS: {len(regressions)}")
+        for v in regressions:
+            out.append(
+                f"  {v['series']}: {v['delta_pct']:+.1f}% beyond the "
+                f"{band * 100:.1f}% band"
+            )
+    else:
+        out.append("REGRESSIONS: none")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench-regression sentry over banked BENCH_*/SCALE_*/"
+        "MULTICHIP_* artifacts"
+    )
+    ap.add_argument("--dir", default=".", help="artifact directory")
+    ap.add_argument(
+        "--band",
+        type=float,
+        default=DEFAULT_BAND,
+        help="noise band as a fraction (default 0.15)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        rows, loaded, fingerprint = scan(args.dir)
+    except SchemaError as exc:
+        print(f"SCHEMA ERROR: {exc}", file=sys.stderr)
+        return 2
+    if not loaded:
+        print(f"no banked artifacts under {args.dir}", file=sys.stderr)
+        return 2
+    verdicts = judge(rows, args.band)
+    print(render(verdicts, rows, loaded, fingerprint, args.band))
+    return 1 if any(v["verdict"] == "REGRESSION" for v in verdicts) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,10 +115,30 @@ def render_frame(rows, now: float, prev) -> str:
         f"{'node':<22}{'health':<11}{'tx/s':>8}{'committed':>11}"
         f"{'p50 ms':>9}{'p99 ms':>9}{'dlv p99':>9}{'live tr':>9}"
         f"{'rej':>6}{'vrf occ':>9}{'vmode':>10}{'q-wait p99':>12}"
+        f"{'lag p99':>9}"
         f"{'backlog':>9}{'dstl rx/ms/dd':>15}{'peers':>7}"
         f"{'epoch':>7}  {'recovery':<16}"
     )
-    lines = [cols, "-" * len(cols)]
+    lines = []
+    # fleet build line: every distinct (git SHA, config hash) the nodes
+    # report — one entry when the fleet is homogeneous, more when a
+    # rollout is in flight and you want to see the split at a glance
+    builds = []
+    for _addr, sz in rows:
+        if isinstance(sz, Exception):
+            continue
+        b = sz.get("build", {})
+        if not b:  # brokers / older nodes don't report one
+            continue
+        cell = (
+            f"git={b.get('git_sha', '?')} cfg={b.get('config_hash', '?')} "
+            f"py={b.get('python', '?')} jax={b.get('jax', '?')}"
+        )
+        if cell not in builds:
+            builds.append(cell)
+    if builds:
+        lines.append("build: " + " | ".join(builds))
+    lines += [cols, "-" * len(cols)]
     for addr, sz in rows:
         if isinstance(sz, Exception):
             lines.append(f"{addr:<22}{'DOWN':<9}{type(sz).__name__}: {sz}")
@@ -157,6 +177,7 @@ def render_frame(rows, now: float, prev) -> str:
                 f"{'-':>9}"
                 f"{'-':>10}"
                 f"{'-':>12}"
+                f"{'-':>9}"
                 f"{pend:>9}"
                 f"{drops:>15}"
                 f"{_num(stats, 'broker_registrations'):>7}"
@@ -194,6 +215,10 @@ def render_frame(rows, now: float, prev) -> str:
             vmode_s = "-"
         qw = vstages.get("queue_wait", {}).get("p99_ms")
         qw_s = f"{qw:.2f}" if isinstance(qw, (int, float)) else "-"
+        # event-loop lag p99 from the continuous lag probe (ISSUE 11):
+        # a loaded loop shows up here before tx latency degrades
+        lag = stats.get("event_loop_lag_p99_ms")
+        lag_s = f"{lag:.2f}" if isinstance(lag, (int, float)) else "-"
         # broker-ingress tier: distilled batches received / directory
         # misses / cross-frame dedup drops, one compact cell
         dstl_s = (
@@ -214,6 +239,7 @@ def render_frame(rows, now: float, prev) -> str:
             f"{occ_s:>9}"
             f"{vmode_s:>10}"
             f"{qw_s:>12}"
+            f"{lag_s:>9}"
             f"{_num(stats, 'slots_undelivered'):>9}"
             f"{dstl_s:>15}"
             f"{_num(health, 'peers_connected'):>4}/"
@@ -282,11 +308,14 @@ async def _poll(addrs, timeout: float):
     return [(f"{h}:{p}", r) for (h, p), r in zip(addrs, results)]
 
 
-def once_verdict(rows, recovery_deadline: float) -> list:
+def once_verdict(rows, recovery_deadline: float,
+                 lag_deadline: float = None) -> list:
     """The ``--once`` gate: addresses (with reasons) that fail it.
     Down and degraded always fail; ``recovering`` fails only past
-    ``recovery_deadline`` seconds of recovery elapsed time. Pure
-    function of its inputs — unit-testable."""
+    ``recovery_deadline`` seconds of recovery elapsed time; with
+    ``lag_deadline`` set, an otherwise-healthy node whose event-loop
+    lag p99 exceeds it (ms) fails too. Pure function of its inputs —
+    unit-testable."""
     bad = []
     for addr, sz in rows:
         if isinstance(sz, Exception):
@@ -294,6 +323,11 @@ def once_verdict(rows, recovery_deadline: float) -> list:
             continue
         status = sz.get("health", {}).get("status")
         if status == "ok":
+            if lag_deadline is not None:
+                lag = sz.get("stats", {}).get("event_loop_lag_p99_ms")
+                if isinstance(lag, (int, float)) and lag > lag_deadline:
+                    bad.append(f"{addr} (event-loop lag p99 {lag:.2f}ms > "
+                               f"{lag_deadline:g}ms deadline)")
             continue
         if status == "recovering":
             elapsed = sz.get("recovery", {}).get("elapsed_s", 0.0)
@@ -309,9 +343,50 @@ def once_verdict(rows, recovery_deadline: float) -> list:
     return bad
 
 
+async def run_profilez(addrs, duration: float, limit: int = 10,
+                       out=None) -> int:
+    """One-shot sampling capture: start each node's sampler via
+    /profilez?start, wait out the window, print the top ``limit``
+    folded stacks per node. Nonzero when any node is unreachable or
+    has the profiler kill-switched off."""
+    out = out or sys.stdout
+    rc = 0
+    started = await asyncio.gather(
+        *(fetch_json(h, p, f"/profilez?start&duration={duration:g}")
+          for h, p in addrs),
+        return_exceptions=True,
+    )
+    await asyncio.sleep(duration + 0.5)
+    dumps = await asyncio.gather(
+        *(fetch_json(h, p, "/profilez") for h, p in addrs),
+        return_exceptions=True,
+    )
+    for (h, p), st, dump in zip(addrs, started, dumps):
+        addr = f"{h}:{p}"
+        if isinstance(st, Exception) or isinstance(dump, Exception):
+            err = st if isinstance(st, Exception) else dump
+            print(f"{addr}  DOWN {type(err).__name__}: {err}",
+                  file=out, flush=True)
+            rc = 1
+            continue
+        b = dump.get("build", {})
+        samples = dump.get("sampler", {}).get("samples", 0)
+        print(
+            f"{addr}  node={dump.get('node')} git={b.get('git_sha')} "
+            f"cfg={b.get('config_hash')}  {samples} samples "
+            f"over {duration:g}s",
+            file=out,
+        )
+        for line in (dump.get("folded") or [])[:limit]:
+            print(f"  {line}", file=out)
+        print("", file=out, flush=True)
+    return rc
+
+
 async def run(addrs, interval: float, once: bool, clear: bool,
               as_json: bool, out=None,
-              recovery_deadline: float = 120.0) -> int:
+              recovery_deadline: float = 120.0,
+              lag_deadline: float = None) -> int:
     out = out or sys.stdout
     prev: dict = {}
     while True:
@@ -346,7 +421,7 @@ async def run(addrs, interval: float, once: bool, clear: bool,
             # unreachable or self-reports degraded health — a fleet
             # where one node answers is not a healthy fleet. Recovering
             # nodes pass within the deadline (see once_verdict).
-            bad = once_verdict(rows, recovery_deadline)
+            bad = once_verdict(rows, recovery_deadline, lag_deadline)
             if bad:
                 print(f"unhealthy: {', '.join(bad)}", file=sys.stderr)
             return 1 if bad else 0
@@ -376,9 +451,25 @@ def main(argv=None) -> int:
                          "instead of rendering the dashboard")
     ap.add_argument("--limit", type=int, default=None,
                     help="with --tracez: newest N completed traces per poll")
+    ap.add_argument("--profilez", action="store_true",
+                    help="one-shot sampling capture: start each node's "
+                         "profiler, wait --duration, print its top-10 "
+                         "folded stacks")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="with --profilez: capture window in seconds "
+                         "(default 5)")
+    ap.add_argument("--lag-deadline", type=float, default=None,
+                    metavar="MS",
+                    help="with --once: fail the gate when any node's "
+                         "event-loop lag p99 exceeds this many ms")
     args = ap.parse_args(argv)
     addrs = [_parse_addr(a) for a in args.nodes]
     try:
+        if args.profilez:
+            return asyncio.run(
+                run_profilez(addrs, args.duration,
+                             args.limit if args.limit is not None else 10)
+            )
         if args.tracez:
             return asyncio.run(
                 run_tracez(addrs, args.interval, args.once, args.limit)
@@ -386,7 +477,8 @@ def main(argv=None) -> int:
         return asyncio.run(
             run(addrs, args.interval, args.once,
                 clear=not args.no_clear, as_json=args.json,
-                recovery_deadline=args.recovery_deadline)
+                recovery_deadline=args.recovery_deadline,
+                lag_deadline=args.lag_deadline)
         )
     except KeyboardInterrupt:
         return 0

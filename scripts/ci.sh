@@ -213,6 +213,28 @@ echo "== observability overhead gate =="
 python -m at2_node_tpu.tools.plane_bench --compare-obs --nodes 3 \
     --txs 200 --repeat 2 --out /dev/null
 
+echo "== profiler smoke gate =="
+# Continuous profiler (ISSUE 11): one short batched firehose with the
+# stack sampler live. Fails unless the capture produced folded stacks
+# and every exercisable phase counter (plane leaves + plane_total +
+# commit_tail + slot_gc) actually ticked — a silent 0 means a marker
+# got dropped from a hot path.
+python -m at2_node_tpu.tools.plane_bench --smoke-profile --nodes 3 \
+    --txs 200 --out /dev/null
+
+echo "== bench-regression sentry gate =="
+# regress.py diffs every banked BENCH_*/SCALE_*/MULTICHIP_* artifact
+# against its nearest COMPARABLE capture (tunnel/device state must
+# match) and exits 1 on a beyond-band drop, 2 on a schema violation.
+# Determinism contract: two runs over the same artifacts are
+# byte-identical.
+python -m at2_node_tpu.tools.regress --dir . > /tmp/_regress1.txt
+python -m at2_node_tpu.tools.regress --dir . > /tmp/_regress2.txt
+cmp /tmp/_regress1.txt /tmp/_regress2.txt || {
+  echo "regression sentry output not deterministic" >&2; exit 1;
+}
+cat /tmp/_regress1.txt
+
 if [ "$tier" = "all" ]; then
   echo "== native sanitizers (TSAN + ASAN) =="
   # the reference gets race-freedom from Rust; the C++ prep library gets

@@ -1,0 +1,450 @@
+"""Continuous-profiler tier tests (obs/profiler.py + /profilez + regress).
+
+Four tiers, mirroring ISSUE 11's moving parts:
+
+* PhaseAccounting — counter exactness under thread concurrency (the
+  decomposition shares are only trustworthy if concurrent markers never
+  lose a nanosecond), chained-segment disjointness, and the free ride
+  through Registry.snapshot();
+* StackSampler — start/stop/restart hygiene, duration self-stop, the
+  bounded tree's ``(truncated)`` collapse, and byte-identical folded
+  output regardless of insertion order (the CI determinism contract);
+* EventLoopLagProbe — exact-zero lag under the sim virtual clock driven
+  via probe_once (no standing timer, no SimScheduler deadlock), real
+  measurements on a live loop;
+* e2e — /profilez through the real PortMux (start/stop/folded/kill-
+  switch), the /statusz build block, and regress.py verdicts over
+  planted fixture artifacts (clean pass, planted regression, tunnel-
+  state-incomparable rows skipped, schema violations).
+"""
+
+import asyncio
+import itertools
+import json
+import threading
+
+import pytest
+
+from at2_node_tpu.crypto.keys import ExchangeKeyPair, SignKeyPair
+from at2_node_tpu.net.peers import Peer
+from at2_node_tpu.node.config import Config, ObservabilityConfig
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.obs import Registry
+from at2_node_tpu.obs.profiler import (
+    PHASES,
+    PLANE_LEAF_PHASES,
+    EventLoopLagProbe,
+    PhaseAccounting,
+    StackSampler,
+    build_info,
+)
+from at2_node_tpu.sim.scheduler import SimClock, SimScheduler
+from at2_node_tpu.tools import regress
+
+_ports = itertools.count(26100)
+
+
+def make_configs(n, **overrides):
+    cfgs = [
+        Config(
+            node_address=f"127.0.0.1:{next(_ports)}",
+            rpc_address=f"127.0.0.1:{next(_ports)}",
+            sign_key=SignKeyPair.random(),
+            network_key=ExchangeKeyPair.random(),
+            **overrides,
+        )
+        for _ in range(n)
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.nodes = [
+            Peer(o.node_address, o.network_key.public, o.sign_key.public)
+            for j, o in enumerate(cfgs)
+            if j != i
+        ]
+    return cfgs
+
+
+# ------------------------------------------------------- phase accounting
+
+
+class TestPhaseAccounting:
+    def test_counters_exact_across_threads(self):
+        reg = Registry()
+        ph = PhaseAccounting(reg)
+        threads, per = 8, 5000
+
+        def work():
+            for _ in range(per):
+                ph.add_ns("rx_decode", 1)
+
+        ts = [threading.Thread(target=work) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert ph.totals()["rx_decode"] == threads * per
+        snap = reg.snapshot()
+        assert snap["phase_rx_decode_ns"] == threads * per
+        # the histogram splays into snapshot() too (per-segment latency)
+        assert snap["phase_rx_decode_count"] == threads * per
+
+    def test_add_chains_disjoint_segments(self):
+        reg = Registry()
+        ph = PhaseAccounting(reg)
+        t0 = ph.t()
+        t1 = ph.add("echo_apply", t0)
+        t2 = ph.add("ready_deliver", t1)
+        assert t0 <= t1 <= t2
+        totals = ph.totals()
+        # chained segments: each add() closes against a FRESH timestamp,
+        # so the two accounts cover disjoint time
+        assert totals["echo_apply"] + totals["ready_deliver"] <= t2 - t0
+        assert totals["quorum_bitmap"] == 0
+
+    def test_vocabulary_covers_the_planes(self):
+        assert set(PLANE_LEAF_PHASES) < set(PHASES)
+        assert "plane_total" in PHASES
+        for off_plane in ("slot_gc", "commit_tail", "verifier_flush"):
+            assert off_plane in PHASES
+            assert off_plane not in PLANE_LEAF_PHASES
+
+
+# ------------------------------------------------------------ stack sampler
+
+
+def _stack(*labels, lineno=7):
+    """Synthetic root-first stack from bare frame names."""
+    return [(f"/x/{name}.py", name, lineno) for name in labels]
+
+
+class TestStackSampler:
+    def test_start_stop_restart_hygiene(self):
+        s = StackSampler(hz=500.0)
+        assert not s.running
+        assert s.start() is True
+        assert s.start() is False  # already running: no-op
+        assert s.running
+        s.stop()
+        s.stop()  # idempotent
+        assert not s.running
+        assert s.start() is True  # restartable
+        s.stop()
+
+    def test_duration_self_stop(self):
+        s = StackSampler(hz=500.0)
+        assert s.start(duration=0.05) is True
+        deadline = 5.0
+        while s.running and deadline > 0:
+            import time
+
+            time.sleep(0.02)
+            deadline -= 0.02
+        assert not s.running
+        assert s.stats()["samples"] > 0
+
+    def test_sampling_captures_live_threads(self):
+        s = StackSampler(hz=500.0)
+        stop = threading.Event()
+
+        def spin_target_fn():
+            while not stop.is_set():
+                sum(range(100))
+
+        t = threading.Thread(target=spin_target_fn, daemon=True)
+        t.start()
+        try:
+            s.start()
+            import time
+
+            time.sleep(0.3)
+            s.stop()
+        finally:
+            stop.set()
+            t.join()
+        assert s.stats()["samples"] > 0
+        assert "spin_target_fn" in s.folded()
+
+    def test_bounded_tree_collapses_to_truncated(self):
+        s = StackSampler(max_nodes=20)
+        s.ingest([_stack(f"fn{i}") for i in range(100)])
+        st = s.stats()
+        # root + 19 distinct leaves + the (truncated) child
+        assert st["nodes"] <= 21
+        assert st["truncated_paths"] > 0
+        assert "(truncated)" in s.folded()
+        # reset() reclaims the budget
+        s.reset()
+        assert s.stats() == {
+            "running": False, "samples": 0, "nodes": 1,
+            "truncated_paths": 0, "hz": 97.0, "duration": None,
+        }
+
+    def test_folded_deterministic_across_insertion_order(self):
+        stacks = [
+            _stack("main", "worker", "decode"),
+            _stack("main", "worker", "verify"),
+            _stack("main", "gc"),
+            _stack("main", "worker", "verify"),
+        ]
+        a, b = StackSampler(), StackSampler()
+        for st in stacks:
+            a.ingest([st])
+        for st in reversed(stacks):
+            b.ingest([st])
+        assert a.folded() == b.folded()
+        folded = a.folded()
+        # leaf frames carry file:func:line, interior frames don't
+        assert "main.py:main;worker.py:worker;verify.py:verify:7 2" in folded
+        assert folded.splitlines()[0].endswith(" 2")  # count-descending
+        # tree view is deterministic too and roots at the shared frame
+        assert a.tree() == b.tree()
+        assert a.tree()["children"][0]["name"] == "main.py:main"
+
+    def test_folded_limit_and_validation(self):
+        s = StackSampler()
+        s.ingest([_stack("a"), _stack("b")])
+        assert len(s.folded(limit=1).splitlines()) == 1
+        with pytest.raises(ValueError):
+            StackSampler(hz=0)
+        with pytest.raises(ValueError):
+            StackSampler(max_nodes=0)
+
+
+# ------------------------------------------------------------- lag probe
+
+
+class TestEventLoopLagProbe:
+    def test_probe_once_exact_zero_under_sim_clock(self):
+        loop = SimScheduler()
+        try:
+            clock = SimClock(loop)
+            reg = Registry()
+            probe = EventLoopLagProbe(reg, clock, interval=0.05)
+            lag = loop.run_until_complete(probe.probe_once())
+            # virtual sleeps are exact: zero overshoot, and the probe
+            # never parks a standing timer that would blunt the
+            # scheduler's deadlock detection
+            assert lag == 0.0
+            snap = reg.snapshot()
+            assert snap["event_loop_lag_count"] == 1
+            assert snap["event_loop_lag_p99_ms"] == 0.0
+        finally:
+            loop.close()
+
+    async def test_standing_loop_measures_real_lag(self):
+        from at2_node_tpu.clock import SYSTEM_CLOCK
+
+        reg = Registry()
+        probe = EventLoopLagProbe(reg, SYSTEM_CLOCK, interval=0.01)
+        probe.start()
+        await asyncio.sleep(0.08)
+        await probe.stop()
+        await probe.stop()  # idempotent
+        count = reg.snapshot()["event_loop_lag_count"]
+        assert count >= 1
+        # stopped: no further observations accrue
+        await asyncio.sleep(0.03)
+        assert reg.snapshot()["event_loop_lag_count"] == count
+
+    def test_interval_validation(self):
+        with pytest.raises(ValueError):
+            EventLoopLagProbe(Registry(), None, interval=0.0)
+
+
+# ------------------------------------------------------------------- e2e
+
+
+async def _get(addr, path):
+    host, _, port = addr.rpartition(":")
+    reader, writer = await asyncio.open_connection(host, int(port))
+    try:
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: n\r\nConnection: close\r\n\r\n"
+            .encode()
+        )
+        await writer.drain()
+        raw = await reader.read()
+    finally:
+        writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b"\r\n", 1)[0].split(b" ")[1])
+    ctype = ""
+    for ln in head.split(b"\r\n")[1:]:
+        if ln.lower().startswith(b"content-type:"):
+            ctype = ln.split(b":", 1)[1].strip().decode()
+    return status, ctype, body
+
+
+class _Node:
+    def __init__(self, **overrides):
+        self.config = make_configs(1, **overrides)[0]
+
+    async def __aenter__(self):
+        self.service = await Service.start(self.config)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.service.close()
+
+
+class TestProfilezEndpoint:
+    async def test_capture_cycle_through_real_mux(self):
+        async with _Node() as node:
+            addr = node.config.rpc_address
+
+            # idle dump: JSON shape with build + phases + empty capture
+            status, ctype, body = await _get(addr, "/profilez")
+            assert status == 200 and ctype.startswith("application/json")
+            doc = json.loads(body)
+            assert set(doc) >= {
+                "node", "build", "sampler", "phases", "folded", "tree",
+            }
+            assert set(doc["phases"]) == set(PHASES)
+            assert doc["build"]["python"] == build_info()["python"]
+
+            # start a long capture, confirm running, then stop it
+            status, _, body = await _get(addr, "/profilez?start&duration=30")
+            assert status == 200
+            started = json.loads(body)
+            assert started["started"] is True and started["running"]
+            assert node.service.sampler.running
+            # second start while running is a no-op
+            _, _, body = await _get(addr, "/profilez?start")
+            assert json.loads(body)["started"] is False
+            await asyncio.sleep(0.05)
+            status, _, body = await _get(addr, "/profilez?stop")
+            assert status == 200
+            stopped = json.loads(body)
+            assert not stopped["running"] and stopped["samples"] > 0
+
+            # folded text view of the finished capture
+            status, ctype, body = await _get(addr, "/profilez?fmt=folded")
+            assert status == 200 and ctype.startswith("text/plain")
+            assert b" " in body  # "stack count" lines
+
+    async def test_kill_switch_404s(self):
+        async with _Node(
+            observability=ObservabilityConfig(profilez=False)
+        ) as node:
+            status, _, body = await _get(
+                node.config.rpc_address, "/profilez"
+            )
+            assert status == 404 and body == b"not found"
+
+    async def test_statusz_build_block(self):
+        async with _Node() as node:
+            status, _, body = await _get(node.config.rpc_address, "/statusz")
+            assert status == 200
+            build = json.loads(body)["build"]
+            assert build["python"] == build_info()["python"]
+            assert len(build["config_hash"]) == 12
+            assert build["uptime_s"] >= 0.0
+            # the lag probe is live on a served node: its histogram
+            # splays into stats once the first interval elapses
+            await asyncio.sleep(0.15)
+            _, _, body = await _get(node.config.rpc_address, "/statusz")
+            stats = json.loads(body)["stats"]
+            assert stats.get("event_loop_lag_count", 0) >= 1
+
+
+# ------------------------------------------------------------ regress.py
+
+
+def _bench_doc(value, tunnel=..., device="cpu"):
+    parsed = {
+        "metric": "committed_tx_per_sec",
+        "unit": "tx/s",
+        "value": value,
+        "device": device,
+    }
+    if tunnel is not ...:
+        parsed["tunnel_live_at_write"] = tunnel
+    return {"cmd": "python bench.py", "rc": 0, "tail": "ok",
+            "parsed": parsed}
+
+
+def _write(tmp_path, name, doc):
+    (tmp_path / name).write_text(json.dumps(doc))
+
+
+class TestRegressSentry:
+    def test_clean_pass_and_determinism(self, tmp_path, capsys):
+        _write(tmp_path, "BENCH_r01.json", _bench_doc(100.0, tunnel=False))
+        _write(tmp_path, "BENCH_r02.json", _bench_doc(103.0, tunnel=False))
+        assert regress.main(["--dir", str(tmp_path)]) == 0
+        out1 = capsys.readouterr().out
+        assert "REGRESSIONS: none" in out1
+        assert "ok (+3.0% vs r01)" in out1
+        assert regress.main(["--dir", str(tmp_path)]) == 0
+        assert capsys.readouterr().out == out1  # byte-identical
+
+    def test_planted_regression_exits_nonzero(self, tmp_path, capsys):
+        _write(tmp_path, "BENCH_r01.json", _bench_doc(100.0, tunnel=False))
+        _write(tmp_path, "BENCH_r02.json", _bench_doc(70.0, tunnel=False))
+        assert regress.main(["--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION (-30.0% vs r01)" in out
+        assert "REGRESSIONS: 1" in out
+
+    def test_in_band_noise_passes(self, tmp_path, capsys):
+        _write(tmp_path, "BENCH_r01.json", _bench_doc(100.0, tunnel=False))
+        _write(tmp_path, "BENCH_r02.json", _bench_doc(90.0, tunnel=False))
+        assert regress.main(["--dir", str(tmp_path)]) == 0
+        assert "ok (-10.0% vs r01)" in capsys.readouterr().out
+        # same drop with a tighter band IS a regression
+        assert regress.main(["--dir", str(tmp_path), "--band", "0.05"]) == 1
+        capsys.readouterr()
+
+    def test_tunnel_mismatch_rows_are_skipped(self, tmp_path, capsys):
+        # cpu-fallback capture (tunnel False) vs live-chip capture
+        # (tunnel True): a 10x "drop" that must NOT be judged
+        _write(tmp_path, "BENCH_r01.json", _bench_doc(1000.0, tunnel=True))
+        _write(tmp_path, "BENCH_r02.json", _bench_doc(100.0, tunnel=False))
+        assert regress.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "skipped (no comparable baseline" in out
+        # legacy captures (no flag at all) only compare to legacy ones
+        _write(tmp_path, "BENCH_r03.json", _bench_doc(100.0))
+        _write(tmp_path, "BENCH_r04.json", _bench_doc(50.0))
+        assert regress.main(["--dir", str(tmp_path)]) == 1
+        assert "REGRESSION (-50.0% vs r03)" in capsys.readouterr().out
+
+    def test_schema_violation_exits_2(self, tmp_path, capsys):
+        doc = _bench_doc(100.0, tunnel=False)
+        del doc["parsed"]["value"]
+        _write(tmp_path, "BENCH_r01.json", doc)
+        assert regress.main(["--dir", str(tmp_path)]) == 2
+        assert "SCHEMA ERROR" in capsys.readouterr().err
+        (tmp_path / "BENCH_r01.json").write_text("{not json")
+        assert regress.main(["--dir", str(tmp_path)]) == 2
+        capsys.readouterr()
+
+    def test_empty_dir_exits_2(self, tmp_path, capsys):
+        assert regress.main(["--dir", str(tmp_path)]) == 2
+        capsys.readouterr()
+
+    def test_scale_family_lower_better_direction(self, tmp_path, capsys):
+        def scale(commit_seconds):
+            return {
+                "net": {
+                    "nodes": 4, "clients": 8, "submitted": 400,
+                    "committed": 400, "committed_tx_per_sec": 100.0,
+                    "commit_seconds": commit_seconds,
+                },
+                "replay": {"status": "ok"},
+            }
+
+        _write(tmp_path, "SCALE_r01.json", scale(10.0))
+        _write(tmp_path, "SCALE_r02.json", scale(20.0))  # latency DOUBLED
+        assert regress.main(["--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "scale/net.commit_seconds" in out
+        assert "REGRESSION (-100.0% vs r01)" in out
+
+    def test_real_repo_artifacts_load_clean(self, capsys):
+        # the actual banked artifact set must always satisfy its schemas
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        assert regress.main(["--dir", repo]) == 0
+        capsys.readouterr()
