@@ -1,0 +1,606 @@
+"""Sharded broadcast plane: per-origin slot shards behind one ingress.
+
+The monolithic :class:`~.stack.Broadcast` runs every slot state machine
+on the event loop, which caps plane-only capacity at one core no matter
+how many the host has. This module partitions that state by ORIGIN KEY —
+the first key of every slot: client sender for the per-tx plane, batch
+origin for the batch plane — into N full :class:`Broadcast` cores, each
+owning a disjoint slice of quorum bitmaps, dedup sets, slot GC, and
+poison resolution. Partitioning by the slot's own key means every
+message about a given slot lands on the same shard, so no per-slot state
+is ever shared and the cores need no locks.
+
+What stays on the owner loop (cross-shard concerns):
+
+* ingress: ONE inbox, one parse pass (native ingest when available),
+  and ONE bulk ``verify_many`` per drain cycle across all shards — the
+  batched verifier keeps its amortization regardless of shard count;
+* the delivered queue the service's commit tail consumes (commit-tail
+  ordering is whatever order shard effects are applied in, exactly as
+  the monolithic plane's was worker-chunk order);
+* the entry registry — the (client sender, seq) -> first-endorsed-entry
+  equivocation guard spans BOTH planes, and a client's per-tx slots and
+  the node batches carrying that client's entries can hash to different
+  shards, so the registry is one shared structure injected into every
+  core;
+* membership epochs, watermark export (merged), stats (one shared
+  counter group), and the stall-kick signal.
+
+Executor seam (parallel/plane.py): ``inline`` runs every shard closure
+synchronously on the caller IN ARRIVAL ORDER — one logical worker, so
+the wire behavior is byte-identical to the monolithic plane and the
+same-seed sim campaign hash is IDENTICAL at shards=1 and shards=4
+(tests/test_plane_shards.py). ``thread`` pins one OS thread per shard;
+Python-level transitions still serialize on the GIL, so the real-host
+scaling comes from the GIL-released native kernels (quorum counting,
+parse, verify) overlapping across shards. Shard threads never touch the
+mesh or the delivered queue directly: effects are handed back through
+bounded SPSC queues and applied by the owner loop after each dispatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from ..parallel.plane import SPSCQueue, make_plane_executor
+from .messages import (
+    BATCH,
+    Attestation,
+    BatchAttestation,
+    BatchContentRequest,
+    ContentRequest,
+    Payload,
+    TxBatch,
+)
+from .stack import (
+    GC_INTERVAL,
+    INBOX_MAX_BYTES,
+    RETRANSMIT_BUDGET_PER_PASS,
+    STALL_KICK_MIN_INTERVAL,
+    WORKER_CHUNK,
+    Broadcast,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ShardedPlane", "shard_of"]
+
+# plane_shard_handoff_ns histogram ladder: 1µs .. ~33s, in ns.
+_HANDOFF_BOUNDS = tuple(1e3 * 2.0**i for i in range(26))
+
+
+def shard_of(key: bytes, shards: int) -> int:
+    """Stable origin-key -> shard map. The first 8 bytes of an ed25519
+    key are uniform, so a modulus spreads origins evenly; stability (no
+    dependence on arrival order or shard load) is what makes the
+    partition deterministic and the sim hash shard-count-invariant."""
+    return int.from_bytes(key[:8], "little") % shards
+
+
+class _ShardMesh:
+    """Mesh facade for a THREADED shard core: reads delegate, sends are
+    queued as effects for the owner loop (mesh transports are event-loop
+    affine and must not be touched from shard threads)."""
+
+    __slots__ = ("_real", "_effects")
+
+    def __init__(self, real, effects: SPSCQueue) -> None:
+        self._real = real
+        self._effects = effects
+
+    @property
+    def peers(self):
+        return self._real.peers
+
+    @property
+    def by_sign(self):
+        return self._real.by_sign
+
+    def send(self, peer, data: bytes) -> None:
+        self._effects.put(("send", peer, data))
+
+    def broadcast(self, data: bytes) -> None:
+        self._effects.put(("broadcast", data))
+
+
+class _ShardDelivered:
+    """Delivered-queue facade for a THREADED shard core: deliveries are
+    effects, re-put into the real asyncio queue by the owner."""
+
+    __slots__ = ("_effects",)
+
+    def __init__(self, effects: SPSCQueue) -> None:
+        self._effects = effects
+
+    def put_nowait(self, payload) -> None:
+        self._effects.put(("deliver", payload))
+
+
+class ShardedPlane:
+    """N per-origin :class:`Broadcast` shard cores behind one ingress.
+
+    Drop-in for :class:`Broadcast` at the service seam: same
+    constructor shape (plus ``shards`` / ``executor``), same public
+    surface (``on_frame``/``broadcast``/``broadcast_batch``/
+    ``delivered``/``stats``/handler hooks/watermarks/thresholds).
+    ``shards=1`` deployments should keep constructing ``Broadcast``
+    directly (node/service.py does) — this class earns its overhead
+    only when there are cores to spread across.
+    """
+
+    def __init__(
+        self,
+        keypair,
+        mesh,
+        verifier,
+        *,
+        shards: int = 2,
+        executor: str = "thread",
+        echo_threshold: Optional[int] = None,
+        ready_threshold: Optional[int] = None,
+        workers: int = 4,
+        registry=None,
+        trace=None,
+        recorder=None,
+        clock=None,
+        phases=None,
+    ) -> None:
+        from ..clock import SYSTEM_CLOCK
+        from ..obs.registry import Registry
+
+        if shards <= 0:
+            raise ValueError("ShardedPlane needs >= 1 shard")
+        self.shards = shards
+        self.keypair = keypair
+        self.mesh = mesh
+        self.verifier = verifier
+        self.clock = SYSTEM_CLOCK if clock is None else clock
+        self.workers = workers
+        self.registry = Registry() if registry is None else registry
+        self.trace = trace
+        self.recorder = recorder
+        self.phases = phases
+        self.delivered: asyncio.Queue = asyncio.Queue()
+        self._inbox: asyncio.Queue = asyncio.Queue(maxsize=65536)
+        self._inbox_bytes = 0
+        self._tasks: list = []
+        self._executor = make_plane_executor(executor, shards)
+        self._inline = self._executor.name == "inline"
+
+        # one effects lane per shard (only drained in threaded mode, but
+        # constructed always so instruments exist and stay cheap)
+        self._effects: List[SPSCQueue] = [SPSCQueue() for _ in range(shards)]
+        self._stall_pending = False
+        # plane-level stall hysteresis for the inline global GC pass
+        # (Broadcast._gc_resolve_stall duck-types against these)
+        self._stall_last_kick = float("-inf")
+        self._stall_backoff = STALL_KICK_MIN_INTERVAL
+
+        # service-facing hooks, fanned into the cores below
+        self.catchup_handler = None
+        self.directory_handler = None
+        self.config_handler = None
+        self.stall_handler = None
+
+        self.stats = self.registry.counter_group((
+            "gossip_rx",
+            "echo_rx",
+            "ready_rx",
+            "invalid_sig",
+            "delivered",
+            "slots_dropped",
+            "content_req_tx",
+            "content_req_rx",
+            "content_served",
+            "batch_rx",
+            "batch_echo_rx",
+            "batch_ready_rx",
+            "batch_entries_delivered",
+            "retransmits",
+            "poison_resolved",
+            "slots_retired",
+            "stall_kicks_suppressed",
+        ))
+
+        self._cores: List[Broadcast] = []
+        for sid in range(shards):
+            core = Broadcast(
+                keypair,
+                mesh if self._inline else _ShardMesh(mesh, self._effects[sid]),
+                verifier,  # unused by cores (owner runs the bulk verify)
+                echo_threshold=echo_threshold,
+                ready_threshold=ready_threshold,
+                workers=0,
+                registry=None,  # private registry; shared stats below
+                trace=trace if self._inline else None,
+                recorder=recorder if self._inline else None,
+                clock=self.clock,
+                phases=(
+                    phases.shard_view(sid, self.registry)
+                    if phases is not None
+                    else None
+                ),
+            )
+            core.stats = self.stats  # ONE aggregate counter group
+            if self._inline:
+                core.delivered = self.delivered
+                core.stall_handler = self._fire_stall
+            else:
+                core.delivered = _ShardDelivered(self._effects[sid])
+                core.stall_handler = self._make_thread_stall(sid)
+            self._cores.append(core)
+        # the equivocation registry spans shards (module docstring):
+        # every core binds and reads through ONE shared instance
+        shared_registry = self._cores[0]._entry_registry
+        for core in self._cores[1:]:
+            core._entry_registry = shared_registry
+        # ONE slot-birth counter across cores: the global creation
+        # ordinal reconstructs the monolithic plane's GC iteration order
+        # (see _gc_pass_global)
+        shared_births = self._cores[0]._birth_seq
+        for core in self._cores[1:]:
+            core._birth_seq = shared_births
+
+        self.registry.gauge(
+            "slots_undelivered", "live undelivered broadcast slots",
+            fn=lambda: sum(c._undelivered for c in self._cores),
+        )
+        self.registry.gauge(
+            "inbox_depth", "raw frames queued for the broadcast workers",
+            fn=lambda: self._inbox.qsize(),
+        )
+        self.registry.gauge(
+            "plane_shards", "broadcast plane shard count",
+            fn=lambda: float(self.shards),
+        )
+        self.registry.gauge(
+            "plane_shard_queue_depth",
+            "deepest shard effects SPSC queue right now",
+            fn=lambda: float(max(len(q) for q in self._effects)),
+        )
+        self._handoff_hist = self.registry.histogram(
+            "plane_shard_handoff_ns",
+            "shard effect enqueue-to-apply latency (ns)",
+            bounds=_HANDOFF_BOUNDS,
+        )
+
+    # -- threshold fan-out (service reconfigures these on membership
+    # epochs; every core must agree or quorum math diverges per shard) --
+
+    @property
+    def echo_threshold(self) -> int:
+        return self._cores[0].echo_threshold
+
+    @echo_threshold.setter
+    def echo_threshold(self, value: int) -> None:
+        for core in self._cores:
+            core.echo_threshold = value
+
+    @property
+    def ready_threshold(self) -> int:
+        return self._cores[0].ready_threshold
+
+    @ready_threshold.setter
+    def ready_threshold(self, value: int) -> None:
+        for core in self._cores:
+            core.ready_threshold = value
+
+    @property
+    def on_attest(self):
+        return self._cores[0].on_attest
+
+    @on_attest.setter
+    def on_attest(self, hook) -> None:
+        for core in self._cores:
+            core.on_attest = hook
+
+    @property
+    def floor_refusals(self) -> int:
+        return sum(c.floor_refusals for c in self._cores)
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        from ..native import ingest_available
+
+        await asyncio.get_running_loop().run_in_executor(None, ingest_available)
+        for _ in range(self.workers):
+            self._tasks.append(asyncio.create_task(self._worker()))
+        self._tasks.append(asyncio.create_task(self._gc_loop()))
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        self._executor.shutdown()
+
+    # -- ingress (mirrors Broadcast.on_frame admission exactly) -----------
+
+    async def on_frame(self, peer, frame: bytes) -> None:
+        if self.recorder is not None and frame:
+            self.recorder.record("rx", (frame[0], len(frame), peer.address))
+        if self._inbox_bytes + len(frame) > INBOX_MAX_BYTES:
+            logger.warning("inbox byte budget exhausted; dropping frame")
+            if self.recorder is not None:
+                self.recorder.record("rx_drop", ("bytes", len(frame)))
+            return
+        try:
+            self._inbox.put_nowait((peer, frame))
+        except asyncio.QueueFull:
+            logger.warning("inbox overflow; dropping frame")
+            if self.recorder is not None:
+                self.recorder.record("rx_drop", ("depth", len(frame)))
+        else:
+            self._inbox_bytes += len(frame)
+
+    async def broadcast(self, payload: Payload) -> None:
+        await self._inbox.put((None, payload))
+
+    async def broadcast_batch(self, batch: TxBatch) -> None:
+        await self._inbox.put((None, batch))
+
+    # -- routing ----------------------------------------------------------
+
+    def _route(self, msg) -> int:
+        """The owning shard id for a message — keyed by the SLOT's
+        origin key so every message about one slot lands on one core."""
+        if isinstance(msg, Payload):
+            key = msg.sender
+        elif isinstance(msg, Attestation):
+            key = msg.sender
+        elif isinstance(msg, TxBatch):
+            key = msg.origin
+        elif isinstance(msg, BatchAttestation):
+            key = msg.batch_origin
+        elif isinstance(msg, ContentRequest):
+            key = msg.sender
+        elif isinstance(msg, BatchContentRequest):
+            key = msg.batch_origin
+        else:
+            # control plane (catchup / directory / config): stateless wrt
+            # shard slots — handled wherever, keep it on core 0
+            return 0
+        return shard_of(key, self.shards)
+
+    # -- drain cycle ------------------------------------------------------
+
+    async def _worker(self) -> None:
+        while True:
+            item = await self._inbox.get()
+            chunk = [item]
+            while len(chunk) < WORKER_CHUNK:
+                try:
+                    chunk.append(self._inbox.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            for _, payload in chunk:
+                if isinstance(payload, (bytes, bytearray, memoryview)):
+                    self._inbox_bytes -= len(payload)
+            ph = self.phases
+            t_plane = ph.begin_plane() if ph is not None else 0
+            t0 = ph.t() if ph is not None else 0
+            try:
+                msgs = self._cores[0]._parse_chunk(chunk)
+                if ph is not None:
+                    ph.add("rx_decode", t0)
+                await self._process_chunk(msgs)
+            except Exception:
+                logger.exception("sharded plane worker error")
+            if ph is not None:
+                ph.end_plane(t_plane)
+
+    async def _process_chunk(self, msgs) -> None:
+        """Stage 1 per message in ARRIVAL order on the owning core, ONE
+        bulk verify for the whole chunk, stage 3 in arrival order
+        (inline) or grouped per shard on the executor (threaded)."""
+        ph = self.phases
+        t0 = ph.t() if ph is not None else 0
+        to_verify: list = []
+        actions: list = []  # (shard_id, (kind, msg, n_sigs))
+        scratch: list = []
+        for peer, msg in msgs:
+            sid = self._route(msg)
+            self._cores[sid]._pre_msg(peer, msg, to_verify, scratch)
+            if scratch:
+                actions.append((sid, scratch[0]))
+                scratch.clear()
+        if ph is not None:
+            t0 = ph.add("rx_decode", t0)
+        if not to_verify:
+            if not self._inline:
+                self._flush_effects()
+            self._maybe_fire_stall()
+            return
+        results = await self.verifier.verify_many(to_verify)
+        if ph is not None:
+            ph.add("verify_wait", t0)
+
+        idx = 0
+        if self._inline:
+            for sid, (kind, msg, n_sigs) in actions:
+                ok = results[idx]
+                entry_oks = (
+                    results[idx + 1 : idx + n_sigs] if kind == BATCH else None
+                )
+                idx += n_sigs
+                self._cores[sid]._post_action(kind, msg, ok, entry_oks)
+        else:
+            per_shard: Dict[int, list] = {}
+            for sid, (kind, msg, n_sigs) in actions:
+                ok = results[idx]
+                entry_oks = (
+                    results[idx + 1 : idx + n_sigs] if kind == BATCH else None
+                )
+                idx += n_sigs
+                per_shard.setdefault(sid, []).append(
+                    (kind, msg, ok, entry_oks)
+                )
+            futs = [
+                self._executor.submit(
+                    sid, self._run_actions, self._cores[sid], alist
+                )
+                for sid, alist in per_shard.items()
+            ]
+            if futs:
+                await asyncio.gather(
+                    *(asyncio.wrap_future(f) for f in futs)
+                )
+            self._flush_effects()
+        self._maybe_fire_stall()
+
+    @staticmethod
+    def _run_actions(core: Broadcast, alist) -> None:
+        """Shard-thread entry point: apply this shard's verified actions
+        in order. Exceptions stay on the shard (logged) so one poisoned
+        message cannot take the owner's drain cycle down."""
+        for kind, msg, ok, entry_oks in alist:
+            try:
+                core._post_action(kind, msg, ok, entry_oks)
+            except Exception:
+                logger.exception("shard action error")
+
+    # -- effects + stall marshaling ---------------------------------------
+
+    def _fire_stall(self) -> None:
+        # inline cores call straight through on the owner loop
+        self._stall_pending = True
+
+    def _make_thread_stall(self, sid: int):
+        effects = self._effects[sid]
+
+        def _stall() -> None:
+            effects.put(("stall",))
+
+        return _stall
+
+    def _flush_effects(self) -> None:
+        """Apply queued shard effects on the owner loop (threaded mode).
+        Per-queue FIFO keeps each shard's sends in its own order — the
+        same guarantee the monolithic plane gave within a worker chunk."""
+        worst = 0
+        for q in self._effects:
+            items, handoff = q.drain()
+            if handoff > worst:
+                worst = handoff
+            for item in items:
+                tag = item[0]
+                if tag == "send":
+                    self.mesh.send(item[1], item[2])
+                elif tag == "broadcast":
+                    self.mesh.broadcast(item[1])
+                elif tag == "deliver":
+                    self.delivered.put_nowait(item[1])
+                elif tag == "stall":
+                    self._stall_pending = True
+        if worst > 0:
+            self._handoff_hist.observe(worst)
+
+    def _maybe_fire_stall(self) -> None:
+        if not self._stall_pending:
+            return
+        self._stall_pending = False
+        if self.stall_handler is not None:
+            try:
+                self.stall_handler()
+            except Exception:
+                logger.exception("stall handler error")
+
+    # -- GC ---------------------------------------------------------------
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await self.clock.sleep(GC_INTERVAL)
+            now = self.clock.monotonic()
+            if self._inline:
+                self._gc_pass_global(now)
+            else:
+                futs = [
+                    self._executor.submit(sid, core._gc_pass, now)
+                    for sid, core in enumerate(self._cores)
+                ]
+                await asyncio.gather(
+                    *(asyncio.wrap_future(f) for f in futs),
+                    return_exceptions=True,
+                )
+                self._flush_effects()
+            self._maybe_fire_stall()
+
+    def _gc_pass_global(self, now: float) -> None:
+        """Inline (sim) GC: interleave EVERY shard's slots in global
+        creation order under ONE retransmit budget and ONE plane-level
+        stall hysteresis — exactly the pass the monolithic plane runs
+        over its single insertion-ordered slot dict, so retransmission
+        order (and with it the sim wire trace) is shard-count-invariant.
+        Threaded mode keeps per-core passes instead: real-time hosts buy
+        GC parallelism with a per-shard budget, a trade the sim never
+        makes."""
+        ph = self.phases
+        t_gc = ph.t() if ph is not None else 0
+        budget = [RETRANSMIT_BUDGET_PER_PASS]
+        stalled = False
+        tx = [
+            (state.birth, core, slot)
+            for core in self._cores
+            for slot, state in core._slots.items()
+        ]
+        tx.sort(key=lambda e: e[0])
+        for _, core, slot in tx:
+            if core._gc_tx_slot(slot, now, budget):
+                stalled = True
+        batches = [
+            (state.birth, core, slot)
+            for core in self._cores
+            for slot, state in core._batch_slots.items()
+        ]
+        batches.sort(key=lambda e: e[0])
+        for _, core, slot in batches:
+            if core._gc_batch_slot(slot, now, budget):
+                stalled = True
+        Broadcast._gc_resolve_stall(self, now, stalled)
+        if ph is not None:
+            ph.add("slot_gc", t_gc)
+
+    # -- cross-shard service surface --------------------------------------
+
+    def release_entry(self, sender: bytes, sequence: int) -> None:
+        # the registry is shared: one pop releases the binding plane-wide
+        self._cores[0].release_entry(sender, sequence)
+
+    def export_watermarks(self) -> dict:
+        """Merge per-shard watermark exports. Keys partition by shard for
+        LIVE attestation bumps, but restored floors are fanned to every
+        core, so merge with max to stay monotone either way."""
+        tx: Dict[str, int] = {}
+        batch: Dict[str, int] = {}
+        for core in self._cores:
+            doc = core.export_watermarks()
+            for k, v in doc["tx"].items():
+                tx[k] = max(tx.get(k, 0), v)
+            for k, v in doc["batch"].items():
+                batch[k] = max(batch.get(k, 0), v)
+        return {"tx": tx, "batch": batch}
+
+    def restore_watermarks(self, doc: dict) -> None:
+        for core in self._cores:
+            core.restore_watermarks(doc)
+
+    def plane_info(self) -> dict:
+        """The /statusz ``plane`` block (tools/top.py shards column)."""
+        return {
+            "shards": self.shards,
+            "executor": self._executor.name,
+            "effects_dropped": sum(q.dropped for q in self._effects),
+        }
+
+    # handler hooks are plain attributes on Broadcast; fan writes through
+    # so cores see the service's callbacks (the sharded plane routes
+    # control messages to core 0, but catchup replies can come from any
+    # core's GC pass via stall, so keep them all consistent)
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name in ("catchup_handler", "directory_handler", "config_handler"):
+            for core in getattr(self, "_cores", ()):  # pre-init writes
+                setattr(core, name, value)

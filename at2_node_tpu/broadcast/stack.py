@@ -82,6 +82,7 @@ single-writer argument as the reference's actors (SURVEY.md §5).
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 from collections import defaultdict
 from typing import Dict, Optional, Set, Tuple
@@ -247,9 +248,18 @@ class _BoundedDict:
 
 _EMPTY_COUNTS = np.zeros(0, dtype=np.int32)
 
+# Below this many entries the ctypes crossing costs more than the numpy
+# ops it replaces; above it the native kernel wins AND releases the GIL,
+# which is what lets ThreadPlaneExecutor shards actually overlap.
+_NATIVE_QUORUM_MIN = 16
+
 
 def _quorate_mask(counts: np.ndarray, threshold: int, nbits: int) -> int:
-    """Bitmap int of entries whose vote count reached the threshold."""
+    """Bitmap int of entries whose vote count reached the threshold.
+
+    Bit-identical on the native (at2_quorum_mask, GIL released) and numpy
+    paths — differential-tested in tests/test_plane_shards.py — so which
+    path runs never affects wire behavior or sim hashes."""
     if nbits <= 0:
         return 0
     if threshold <= 0:
@@ -257,6 +267,13 @@ def _quorate_mask(counts: np.ndarray, threshold: int, nbits: int) -> int:
     n = min(len(counts), nbits)
     if n == 0:
         return 0
+    if n >= _NATIVE_QUORUM_MIN:
+        from ..native.ingest import ingest_ready
+
+        if ingest_ready():
+            from ..native.ingest import quorum_mask_native
+
+            return quorum_mask_native(counts, threshold, n)
     mask = counts[:n] >= threshold
     return int.from_bytes(
         np.packbits(mask, bitorder="little").tobytes(), "little"
@@ -287,10 +304,19 @@ class _BatchVotes:
             grown = np.zeros(nbits, dtype=np.int32)
             grown[: len(self.counts)] = self.counts
             self.counts = grown
+        new_bytes = new.to_bytes((nbits + 7) // 8, "little")
+        if nbits >= _NATIVE_QUORUM_MIN:
+            from ..native.ingest import ingest_ready
+
+            if ingest_ready():
+                from ..native.ingest import counts_add_native
+
+                # GIL-released tally fold (at2_counts_add); arithmetic
+                # identical to the unpackbits path below
+                counts_add_native(new_bytes, self.counts)
+                return True
         delta = np.unpackbits(
-            np.frombuffer(
-                new.to_bytes((nbits + 7) // 8, "little"), dtype=np.uint8
-            ),
+            np.frombuffer(new_bytes, dtype=np.uint8),
             bitorder="little",
         )[:nbits]
         self.counts[:nbits] += delta
@@ -304,6 +330,7 @@ class _BatchState:
 
     __slots__ = (
         "created",
+        "birth",
         "content_requested_at",
         "retransmitted_at",
         "helped_at",
@@ -325,6 +352,7 @@ class _BatchState:
 
     def __init__(self, now: float) -> None:
         self.created = now
+        self.birth = 0  # plane-wide creation ordinal (stamped by creator)
         self.content_requested_at = 0.0
         self.retransmitted_at = 0.0  # last stalled-slot retransmission
         self.helped_at: Dict[bytes, float] = {}  # per-peer help pacing
@@ -370,6 +398,7 @@ class _SlotState:
         "sieve_delivered",
         "delivered",
         "created",
+        "birth",
         "content_requested_at",
         "retransmitted_at",
         "helped_at",
@@ -377,6 +406,7 @@ class _SlotState:
 
     def __init__(self, now: float) -> None:
         self.created = now
+        self.birth = 0  # plane-wide creation ordinal (stamped by creator)
         self.content_requested_at = 0.0  # last pull request, 0 = never
         self.retransmitted_at = 0.0  # last stalled-slot retransmission
         self.helped_at: Dict[bytes, float] = {}  # per-peer help pacing
@@ -513,6 +543,10 @@ class Broadcast:
         self.stall_handler = None
         self._stall_last_kick = float("-inf")
         self._stall_backoff = STALL_KICK_MIN_INTERVAL
+        # slot-creation ordinal: dict insertion order made durable, so a
+        # sharded plane (broadcast/shards.py shares ONE counter across
+        # its cores) can reconstruct the global GC iteration order
+        self._birth_seq = itertools.count()
         # observability (SURVEY.md §5: per-stage counters). The service
         # passes its registry + tx-lifecycle tracer; a standalone stack
         # (unit tests, bench harnesses) gets a private registry and no
@@ -620,104 +654,142 @@ class Broadcast:
         catchup-plane stall signal)."""
         while True:
             await self.clock.sleep(GC_INTERVAL)
-            ph = self.phases
-            t_gc = ph.t() if ph is not None else 0
-            now = self.clock.monotonic()
-            budget = RETRANSMIT_BUDGET_PER_PASS
-            stalled_past_horizon = False
-            for slot in list(self._slots):
-                state = self._slots[slot]
-                age = now - state.created
-                if state.delivered and age > DELIVERED_RETENTION:
-                    self._delivered_slots.add(slot)
-                    del self._slots[slot]
-                elif age > SLOT_MAX_AGE:
-                    if not state.delivered:
-                        self._undelivered -= 1
-                    del self._slots[slot]
-                elif not state.delivered:
-                    # periodic retry of the content pull for quorate slots
-                    # still missing their payload (lost request/response)
-                    for chash, origins in state.readies.items():
-                        if (
-                            len(origins) >= self.ready_threshold
-                            and chash not in state.contents
-                        ):
-                            self._request_content(slot, state, chash)
-                    if budget > 0 and self._retransmit_slot(slot, state, now):
-                        budget -= 1
-                    if age > STALLED_CATCHUP_AFTER:
-                        stalled_past_horizon = True
-            for slot in list(self._batch_slots):
-                bstate = self._batch_slots[slot]
-                age = now - bstate.created
-                if not (bstate.delivered_all or bstate.retired):
-                    # a slot can become retire-eligible between worker
-                    # transitions (e.g. the last quorate entry delivered
-                    # via another content's votes); settle it here so it
-                    # never sits through a pass as a false "stall"
-                    self._maybe_retire_batch(slot, bstate)
-                resolved = bstate.delivered_all or bstate.retired
-                if resolved and age > DELIVERED_RETENTION:
-                    self._delivered_batch_slots.add(slot)
-                    del self._batch_slots[slot]
-                elif age > SLOT_MAX_AGE:
-                    if not resolved:
-                        self._undelivered -= 1
-                    del self._batch_slots[slot]
-                elif not resolved:
-                    # retry the batch pull when quorate entries await content
-                    for chash, rv in bstate.ready_votes.items():
-                        if chash in bstate.contents:
-                            continue
-                        quorate = _quorate_mask(
-                            rv.counts, self.ready_threshold, bstate.nbits
-                        )
-                        if quorate & ~bstate.delivered_bits.get(chash, 0):
-                            self._request_batch_content(slot, bstate, chash)
-                    if budget > 0 and self._retransmit_batch_slot(
-                        slot, bstate, now
-                    ):
-                        budget -= 1
-                    # "stalled awaiting quorum" vs "stalled with
-                    # unresolved poison": only the former can be healed
-                    # by the catchup plane (the slot may be committed
-                    # network-wide). A slot whose only undelivered
-                    # entries are ones WE rejected is poison-blocked —
-                    # a network-wide catchup kick cannot resolve it and
-                    # must not be fired for it.
-                    if age > STALLED_CATCHUP_AFTER and not (
-                        self._poison_blocked_only(bstate)
-                    ):
-                        stalled_past_horizon = True
-            if stalled_past_horizon and self.stall_handler is not None:
-                # beyond push-retransmission: the slot may be committed
-                # network-wide with the helpers' delivered state expiring
-                # — the ledger-catchup plane replays it from history.
-                # Hysteresis: consecutive kicks are spaced at least
-                # _stall_backoff apart (doubling while the stall
-                # persists) so one misbehaving slot cannot trigger a
-                # catchup session every GC pass network-wide.
-                if now - self._stall_last_kick >= self._stall_backoff:
-                    self._stall_last_kick = now
-                    self._stall_backoff = min(
-                        self._stall_backoff * 2, STALL_KICK_MAX_INTERVAL
-                    )
-                    if self.recorder is not None:
-                        self.recorder.record("stall_kick", ())
-                    try:
-                        self.stall_handler()
-                    except Exception:
-                        logger.exception("stall handler error")
-                else:
-                    self.stats["stall_kicks_suppressed"] += 1
-                    if self.recorder is not None:
-                        self.recorder.record("stall_kick_suppressed", ())
-            elif not stalled_past_horizon:
-                # healthy pass: re-arm the hysteresis for the next storm
-                self._stall_backoff = STALL_KICK_MIN_INTERVAL
-            if ph is not None:
-                ph.add("slot_gc", t_gc)
+            self._gc_pass(self.clock.monotonic())
+
+    def _gc_pass(self, now: float) -> None:
+        """One synchronous GC/recovery pass over this plane's slots.
+
+        Split into per-slot steps (:meth:`_gc_tx_slot` /
+        :meth:`_gc_batch_slot`) plus the stall-hysteresis epilogue
+        (:meth:`_gc_resolve_stall`) so the sharded plane
+        (broadcast/shards.py) can interleave EVERY shard's slots in
+        global creation order under one shared retransmit budget — the
+        exact iteration this monolithic pass performs — while this
+        method keeps serving the monolithic plane and the threaded
+        per-shard pass unchanged."""
+        ph = self.phases
+        t_gc = ph.t() if ph is not None else 0
+        budget = [RETRANSMIT_BUDGET_PER_PASS]
+        stalled_past_horizon = False
+        for slot in list(self._slots):
+            if self._gc_tx_slot(slot, now, budget):
+                stalled_past_horizon = True
+        for slot in list(self._batch_slots):
+            if self._gc_batch_slot(slot, now, budget):
+                stalled_past_horizon = True
+        self._gc_resolve_stall(now, stalled_past_horizon)
+        if ph is not None:
+            ph.add("slot_gc", t_gc)
+
+    def _gc_tx_slot(self, slot: Slot, now: float, budget: list) -> bool:
+        """GC/recovery step for ONE per-tx slot; returns True when the
+        slot is stalled past the catchup horizon. ``budget`` is a
+        one-element mutable cell so one retransmission budget can span a
+        whole pass (and, sharded, every shard in the pass)."""
+        state = self._slots.get(slot)
+        if state is None:
+            return False
+        age = now - state.created
+        if state.delivered and age > DELIVERED_RETENTION:
+            self._delivered_slots.add(slot)
+            del self._slots[slot]
+        elif age > SLOT_MAX_AGE:
+            if not state.delivered:
+                self._undelivered -= 1
+            del self._slots[slot]
+        elif not state.delivered:
+            # periodic retry of the content pull for quorate slots
+            # still missing their payload (lost request/response)
+            for chash, origins in state.readies.items():
+                if (
+                    len(origins) >= self.ready_threshold
+                    and chash not in state.contents
+                ):
+                    self._request_content(slot, state, chash)
+            if budget[0] > 0 and self._retransmit_slot(slot, state, now):
+                budget[0] -= 1
+            if age > STALLED_CATCHUP_AFTER:
+                return True
+        return False
+
+    def _gc_batch_slot(self, slot, now: float, budget: list) -> bool:
+        """Batch-plane twin of :meth:`_gc_tx_slot`."""
+        bstate = self._batch_slots.get(slot)
+        if bstate is None:
+            return False
+        age = now - bstate.created
+        if not (bstate.delivered_all or bstate.retired):
+            # a slot can become retire-eligible between worker
+            # transitions (e.g. the last quorate entry delivered
+            # via another content's votes); settle it here so it
+            # never sits through a pass as a false "stall"
+            self._maybe_retire_batch(slot, bstate)
+        resolved = bstate.delivered_all or bstate.retired
+        if resolved and age > DELIVERED_RETENTION:
+            self._delivered_batch_slots.add(slot)
+            del self._batch_slots[slot]
+        elif age > SLOT_MAX_AGE:
+            if not resolved:
+                self._undelivered -= 1
+            del self._batch_slots[slot]
+        elif not resolved:
+            # retry the batch pull when quorate entries await content
+            for chash, rv in bstate.ready_votes.items():
+                if chash in bstate.contents:
+                    continue
+                quorate = _quorate_mask(
+                    rv.counts, self.ready_threshold, bstate.nbits
+                )
+                if quorate & ~bstate.delivered_bits.get(chash, 0):
+                    self._request_batch_content(slot, bstate, chash)
+            if budget[0] > 0 and self._retransmit_batch_slot(
+                slot, bstate, now
+            ):
+                budget[0] -= 1
+            # "stalled awaiting quorum" vs "stalled with
+            # unresolved poison": only the former can be healed
+            # by the catchup plane (the slot may be committed
+            # network-wide). A slot whose only undelivered
+            # entries are ones WE rejected is poison-blocked —
+            # a network-wide catchup kick cannot resolve it and
+            # must not be fired for it.
+            if age > STALLED_CATCHUP_AFTER and not (
+                self._poison_blocked_only(bstate)
+            ):
+                return True
+        return False
+
+    def _gc_resolve_stall(self, now: float, stalled_past_horizon: bool) -> None:
+        """Stall-kick hysteresis epilogue of a GC pass. Duck-typed: the
+        sharded plane calls this unbound with itself as ``self`` so ONE
+        plane-level hysteresis spans all shards (matching the monolithic
+        plane), with per-shard stall state never consulted."""
+        if stalled_past_horizon and self.stall_handler is not None:
+            # beyond push-retransmission: the slot may be committed
+            # network-wide with the helpers' delivered state expiring
+            # — the ledger-catchup plane replays it from history.
+            # Hysteresis: consecutive kicks are spaced at least
+            # _stall_backoff apart (doubling while the stall
+            # persists) so one misbehaving slot cannot trigger a
+            # catchup session every GC pass network-wide.
+            if now - self._stall_last_kick >= self._stall_backoff:
+                self._stall_last_kick = now
+                self._stall_backoff = min(
+                    self._stall_backoff * 2, STALL_KICK_MAX_INTERVAL
+                )
+                if self.recorder is not None:
+                    self.recorder.record("stall_kick", ())
+                try:
+                    self.stall_handler()
+                except Exception:
+                    logger.exception("stall handler error")
+            else:
+                self.stats["stall_kicks_suppressed"] += 1
+                if self.recorder is not None:
+                    self.recorder.record("stall_kick_suppressed", ())
+        elif not stalled_past_horizon:
+            # healthy pass: re-arm the hysteresis for the next storm
+            self._stall_backoff = STALL_KICK_MIN_INTERVAL
 
     def _resend_slot(
         self, slot: Slot, state: _SlotState, peer: Optional[Peer]
@@ -848,8 +920,11 @@ class Broadcast:
             # plane_total wraps the whole drain cycle (parse + process):
             # it is the denominator of the per-node plane decomposition
             # (obs/profiler.py); rx_decode covers the frame parse here,
-            # the admission pre-checks inside _process_chunk chain onto it
+            # the admission pre-checks inside _process_chunk chain onto
+            # it. begin/end_plane (not a bare add_ns) so a cycle that
+            # re-enters the plane in-context accounts its span ONCE.
             ph = self.phases
+            t_plane = ph.begin_plane() if ph is not None else 0
             t0 = ph.t() if ph is not None else 0
             try:
                 msgs = self._parse_chunk(chunk)
@@ -859,7 +934,7 @@ class Broadcast:
             except Exception:
                 logger.exception("broadcast worker error")
             if ph is not None:
-                ph.add_ns("plane_total", ph.t() - t0)
+                ph.end_plane(t_plane)
 
     def _parse_chunk(self, chunk) -> list:
         """Turn a drained inbox chunk into (peer, message) pairs.
@@ -922,61 +997,7 @@ class Broadcast:
         to_verify = []
         actions = []  # (kind, msg, n_sigs)
         for peer, msg in chunk:
-            if isinstance(msg, Payload):
-                if self._pre_gossip(msg):  # noqa: SIM102 (kept parallel)
-                    to_verify.append(
-                        (msg.sender, msg.to_sign(), msg.signature)
-                    )
-                    actions.append((GOSSIP, msg, 1))
-            elif isinstance(msg, TxBatch):
-                if self._pre_batch(msg):
-                    to_verify.append(
-                        (msg.origin, msg.signing_bytes(), msg.signature)
-                    )
-                    entries = msg.entries()
-                    to_verify.extend(
-                        (e.sender, e.to_sign(), e.signature) for e in entries
-                    )
-                    actions.append((BATCH, msg, 1 + len(entries)))
-            elif isinstance(msg, BatchAttestation):
-                if self._pre_batch_attestation(msg, peer):
-                    to_verify.append((msg.origin, msg.to_sign(), msg.signature))
-                    actions.append((msg.phase, msg, 1))
-            elif isinstance(msg, ContentRequest):
-                self._on_request(peer, msg)
-            elif isinstance(msg, BatchContentRequest):
-                self._on_batch_request(peer, msg)
-            elif isinstance(msg, _CATCHUP_KINDS):
-                # synchronous handler (service-side bookkeeping / replies
-                # via mesh.send); heavy work happens in the service's
-                # catchup task, never in this worker
-                if self.catchup_handler is not None and peer is not None:
-                    try:
-                        self.catchup_handler(peer, msg)
-                    except Exception:
-                        logger.exception("catchup handler error")
-            elif isinstance(msg, DirectoryAnnounce):
-                # directory mappings are liveness-only service state
-                # (node/directory.py); synchronous apply, bad mappings
-                # are dropped by the handler's stride/conflict checks
-                if self.directory_handler is not None and peer is not None:
-                    try:
-                        self.directory_handler(peer, msg)
-                    except Exception:
-                        logger.exception("directory handler error")
-            elif isinstance(msg, ConfigTx):
-                # admin-signed membership transitions (node/membership.py);
-                # the handler validates the admin signature and epoch —
-                # peer may be None (admin-side local injection)
-                if self.config_handler is not None:
-                    try:
-                        self.config_handler(peer, msg)
-                    except Exception:
-                        logger.exception("config handler error")
-            else:
-                if self._pre_attestation(msg, peer):
-                    to_verify.append((msg.origin, msg.to_sign(), msg.signature))
-                    actions.append((msg.phase, msg, 1))
+            self._pre_msg(peer, msg, to_verify, actions)
         # admission pre-checks account to rx_decode (receive-side cost)
         if ph is not None:
             t0 = ph.add("rx_decode", t0)
@@ -985,45 +1006,122 @@ class Broadcast:
         results = await self.verifier.verify_many(to_verify)
         if ph is not None:
             ph.add("verify_wait", t0)
+        self._apply_actions(actions, results)
+
+    def _pre_msg(self, peer, msg, to_verify: list, actions: list) -> None:
+        """Stage 1 for ONE message: synchronous admission pre-checks and
+        control-message dispatch. Verify-needing messages append their
+        signature items to ``to_verify`` and an ``(kind, msg, n_sigs)``
+        action; control messages (requests, catchup, directory, config)
+        are handled inline and append nothing. The sharded plane calls
+        this per message in ARRIVAL order (broadcast/shards.py), the
+        monolithic plane from its chunk loop above — identical behavior
+        either way."""
+        if isinstance(msg, Payload):
+            if self._pre_gossip(msg):  # noqa: SIM102 (kept parallel)
+                to_verify.append(
+                    (msg.sender, msg.to_sign(), msg.signature)
+                )
+                actions.append((GOSSIP, msg, 1))
+        elif isinstance(msg, TxBatch):
+            if self._pre_batch(msg):
+                to_verify.append(
+                    (msg.origin, msg.signing_bytes(), msg.signature)
+                )
+                entries = msg.entries()
+                to_verify.extend(
+                    (e.sender, e.to_sign(), e.signature) for e in entries
+                )
+                actions.append((BATCH, msg, 1 + len(entries)))
+        elif isinstance(msg, BatchAttestation):
+            if self._pre_batch_attestation(msg, peer):
+                to_verify.append((msg.origin, msg.to_sign(), msg.signature))
+                actions.append((msg.phase, msg, 1))
+        elif isinstance(msg, ContentRequest):
+            self._on_request(peer, msg)
+        elif isinstance(msg, BatchContentRequest):
+            self._on_batch_request(peer, msg)
+        elif isinstance(msg, _CATCHUP_KINDS):
+            # synchronous handler (service-side bookkeeping / replies
+            # via mesh.send); heavy work happens in the service's
+            # catchup task, never in this worker
+            if self.catchup_handler is not None and peer is not None:
+                try:
+                    self.catchup_handler(peer, msg)
+                except Exception:
+                    logger.exception("catchup handler error")
+        elif isinstance(msg, DirectoryAnnounce):
+            # directory mappings are liveness-only service state
+            # (node/directory.py); synchronous apply, bad mappings
+            # are dropped by the handler's stride/conflict checks
+            if self.directory_handler is not None and peer is not None:
+                try:
+                    self.directory_handler(peer, msg)
+                except Exception:
+                    logger.exception("directory handler error")
+        elif isinstance(msg, ConfigTx):
+            # admin-signed membership transitions (node/membership.py);
+            # the handler validates the admin signature and epoch —
+            # peer may be None (admin-side local injection)
+            if self.config_handler is not None:
+                try:
+                    self.config_handler(peer, msg)
+                except Exception:
+                    logger.exception("config handler error")
+        else:
+            if self._pre_attestation(msg, peer):
+                to_verify.append((msg.origin, msg.to_sign(), msg.signature))
+                actions.append((msg.phase, msg, 1))
+
+    def _apply_actions(self, actions, results) -> None:
+        """Stage 3: walk the action list against the bulk-verify verdicts
+        (each action consumed ``n_sigs`` consecutive results) and run the
+        state transitions, in action order."""
         idx = 0
         for kind, msg, n_sigs in actions:
             ok = results[idx]
-            if kind == BATCH:
-                entry_oks = results[idx + 1 : idx + n_sigs]
+            entry_oks = (
+                results[idx + 1 : idx + n_sigs] if kind == BATCH else None
+            )
             idx += n_sigs
-            if not ok:
-                self.stats["invalid_sig"] += 1
-                if kind == GOSSIP:
-                    logger.warning(
-                        "invalid payload signature for slot (%s, %d)",
-                        msg.sender.hex()[:16],
-                        msg.sequence,
-                    )
-                elif kind == BATCH:
-                    logger.warning(
-                        "invalid batch origin signature from %s",
-                        msg.origin.hex()[:16],
-                    )
-                else:
-                    logger.warning(
-                        "invalid %s signature from %s",
-                        {
-                            ECHO: "echo",
-                            READY: "ready",
-                            BATCH_ECHO: "batch-echo",
-                            BATCH_READY: "batch-ready",
-                        }.get(kind, "attestation"),
-                        msg.origin.hex()[:16],
-                    )
-                continue
+            self._post_action(kind, msg, ok, entry_oks)
+
+    def _post_action(self, kind, msg, ok, entry_oks) -> None:
+        """Stage 3 for ONE verified action: invalid-signature accounting
+        or the kind-specific state transition."""
+        if not ok:
+            self.stats["invalid_sig"] += 1
             if kind == GOSSIP:
-                self._post_gossip(msg)
+                logger.warning(
+                    "invalid payload signature for slot (%s, %d)",
+                    msg.sender.hex()[:16],
+                    msg.sequence,
+                )
             elif kind == BATCH:
-                self._post_batch(msg, entry_oks)
-            elif kind in (BATCH_ECHO, BATCH_READY):
-                self._post_batch_attestation(msg)
+                logger.warning(
+                    "invalid batch origin signature from %s",
+                    msg.origin.hex()[:16],
+                )
             else:
-                self._post_attestation(msg)
+                logger.warning(
+                    "invalid %s signature from %s",
+                    {
+                        ECHO: "echo",
+                        READY: "ready",
+                        BATCH_ECHO: "batch-echo",
+                        BATCH_READY: "batch-ready",
+                    }.get(kind, "attestation"),
+                    msg.origin.hex()[:16],
+                )
+            return
+        if kind == GOSSIP:
+            self._post_gossip(msg)
+        elif kind == BATCH:
+            self._post_batch(msg, entry_oks)
+        elif kind in (BATCH_ECHO, BATCH_READY):
+            self._post_batch_attestation(msg)
+        else:
+            self._post_attestation(msg)
 
     # -- stage 1: synchronous pre-checks (dedup inserts happen here, so no
     # other worker can double-verify the same message) --------------------
@@ -1234,6 +1332,7 @@ class Broadcast:
         state = self._slots.get(slot)
         if state is None:
             state = self._slots[slot] = _SlotState(self.clock.monotonic())
+            state.birth = next(self._birth_seq)
             self._undelivered += 1
         return state
 
@@ -1255,6 +1354,7 @@ class Broadcast:
         state = self._batch_slots.get(slot)
         if state is None:
             state = self._batch_slots[slot] = _BatchState(self.clock.monotonic())
+            state.birth = next(self._birth_seq)
             self._undelivered += 1
         return state
 

@@ -9,11 +9,13 @@ path transparently if compilation fails.
 """
 
 from .ingest import (
+    counts_add_native,
     ingest_available,
     ingest_ready,
     ingest_ready_or_kick,
     kick_ingest_build,
     parse_frames_native,
+    quorum_mask_native,
     verify_bulk_native,
 )
 from .prep import native_available, prep_batch_native
@@ -22,6 +24,7 @@ from .reader import NativeChannelReader, reader_available
 __all__ = [
     "NativeChannelReader",
     "reader_available",
+    "counts_add_native",
     "ingest_available",
     "ingest_ready",
     "ingest_ready_or_kick",
@@ -29,5 +32,6 @@ __all__ = [
     "native_available",
     "parse_frames_native",
     "prep_batch_native",
+    "quorum_mask_native",
     "verify_bulk_native",
 ]

@@ -673,4 +673,50 @@ void at2_reader_stop(void* handle) {
 int64_t at2_ingest_row_stride(void) { return int64_t(kRowStride); }
 int64_t at2_ingest_min_wire(void) { return int64_t(kMinWire); }
 
+// ---------------------------------------------------------------------------
+// Shard-local quorum counting. The sharded broadcast plane keeps its per-slot
+// endorsement bitmaps as little-endian byte strings (Python ints on the wire
+// side) and its vote tallies as int32 arrays. The two hot loops — "fold a
+// newly-seen bitmap into the tally" and "which entries cleared threshold" —
+// used to bounce through numpy per attestation; here they run GIL-released
+// per ctypes call so shard threads genuinely overlap.
+
+// counts[i] += 1 for every set bit i in bm[0..nbytes). ncounts caps the
+// writable tally range; bits at or past it are ignored (callers clamp nbits
+// before ever reaching here, this is belt-and-braces against overrun).
+// Returns the number of bits folded in.
+int64_t at2_counts_add(const uint8_t* bm, int64_t nbytes,
+                       int32_t* counts, int64_t ncounts) {
+  int64_t folded = 0;
+  for (int64_t byte = 0; byte < nbytes; ++byte) {
+    uint8_t b = bm[byte];
+    while (b) {
+      int bit = __builtin_ctz(b);
+      b &= uint8_t(b - 1);
+      int64_t idx = byte * 8 + bit;
+      if (idx < ncounts) {
+        counts[idx] += 1;
+        ++folded;
+      }
+    }
+  }
+  return folded;
+}
+
+// out[0..out_len) becomes the little-endian packed bitmap of indices with
+// counts[i] >= threshold, for i < n. Returns the popcount of the mask.
+int64_t at2_quorum_mask(const int32_t* counts, int64_t n, int32_t threshold,
+                        uint8_t* out, int64_t out_len) {
+  std::memset(out, 0, size_t(out_len));
+  int64_t set = 0;
+  int64_t lim = n < out_len * 8 ? n : out_len * 8;
+  for (int64_t i = 0; i < lim; ++i) {
+    if (counts[i] >= threshold) {
+      out[i >> 3] |= uint8_t(1u << (i & 7));
+      ++set;
+    }
+  }
+  return set;
+}
+
 }  // extern "C"

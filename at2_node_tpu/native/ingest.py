@@ -57,6 +57,8 @@ from ..broadcast.messages import (
 )
 from ._build import U8P, U32P, U64P, load_lib, pack_ragged, ptr8
 
+_I32P = ctypes.POINTER(ctypes.c_int32)
+
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -102,6 +104,14 @@ def _load() -> Optional[ctypes.CDLL]:
             U8P, U64P, U8P, ctypes.c_int64,
         ]
         lib.at2_distill_parse.restype = ctypes.c_int64
+        lib.at2_counts_add.argtypes = [
+            U8P, ctypes.c_int64, _I32P, ctypes.c_int64,
+        ]
+        lib.at2_counts_add.restype = ctypes.c_int64
+        lib.at2_quorum_mask.argtypes = [
+            _I32P, ctypes.c_int64, ctypes.c_int32, U8P, ctypes.c_int64,
+        ]
+        lib.at2_quorum_mask.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -302,3 +312,38 @@ def verify_bulk_native(
         n, n_threads, ptr8(out),
     )
     return out.astype(bool)
+
+
+def counts_add_native(bitmap: bytes, counts: np.ndarray) -> int:
+    """Fold a little-endian endorsement bitmap into an int32 tally array
+    (counts[i] += 1 for every set bit i). GIL released for the scan, so
+    shard threads applying attestations genuinely overlap. Returns the
+    number of bits folded. ``counts`` must be C-contiguous int32 and is
+    mutated in place."""
+    lib = _load()
+    assert lib is not None, "call ingest_available() first"
+    assert counts.dtype == np.int32 and counts.flags["C_CONTIGUOUS"]
+    buf = np.frombuffer(bitmap, dtype=np.uint8)
+    return int(
+        lib.at2_counts_add(
+            ptr8(buf), len(bitmap),
+            counts.ctypes.data_as(_I32P), len(counts),
+        )
+    )
+
+
+def quorum_mask_native(counts: np.ndarray, threshold: int, nbits: int) -> int:
+    """Little-endian packed quorum bitmap (as a Python int) of tally
+    indices with counts[i] >= threshold, over the first ``nbits``
+    entries. The GIL-released native twin of broadcast._quorate_mask."""
+    lib = _load()
+    assert lib is not None, "call ingest_available() first"
+    assert counts.dtype == np.int32 and counts.flags["C_CONTIGUOUS"]
+    n = min(nbits, len(counts))
+    if n <= 0:
+        return 0
+    out = np.zeros((n + 7) // 8, dtype=np.uint8)
+    lib.at2_quorum_mask(
+        counts.ctypes.data_as(_I32P), n, threshold, ptr8(out), len(out)
+    )
+    return int.from_bytes(out.tobytes(), "little")

@@ -336,6 +336,33 @@ class AdmissionConfig:
 
 
 @dataclass
+class PlaneConfig:
+    """The `[plane]` table: broadcast-plane sharding (broadcast/shards.py).
+
+    ``shards = 1`` (the default) keeps the monolithic single-loop plane —
+    the production-safe configuration every existing deployment runs.
+    ``shards > 1`` partitions slot state per origin key across that many
+    shard cores; ``executor`` picks where their drain work runs:
+    ``"thread"`` (one OS thread per shard; scaling comes from the
+    GIL-released native kernels) or ``"inline"`` (synchronous on the
+    event loop — the deterministic mode the sim forces, also useful to
+    measure sharding overhead without threads). ``workers`` is the
+    owner-loop drain task count for the sharded ingress."""
+
+    shards: int = 1
+    executor: str = "thread"
+    workers: int = 4
+
+    def __post_init__(self) -> None:
+        if self.shards < 1:
+            raise ValueError("plane.shards must be >= 1")
+        if self.executor not in ("thread", "inline"):
+            raise ValueError("plane.executor must be 'thread' or 'inline'")
+        if self.workers < 1:
+            raise ValueError("plane.workers must be >= 1")
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -353,6 +380,7 @@ class Config:
     catchup: CatchupConfig = field(default_factory=CatchupConfig)
     batching: BatchingConfig = field(default_factory=BatchingConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    plane: PlaneConfig = field(default_factory=PlaneConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -471,6 +499,15 @@ class Config:
                 f"fail_limit = {ad.fail_limit}",
                 f"fail_window = {ad.fail_window}",
             ]
+        pl = self.plane
+        if pl != PlaneConfig():
+            lines += [
+                "",
+                "[plane]",
+                f"shards = {pl.shards}",
+                f'executor = "{pl.executor}"',
+                f"workers = {pl.workers}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -493,6 +530,7 @@ class Config:
         catchup = CatchupConfig(**doc.get("catchup", {}))
         batching = BatchingConfig(**doc.get("batching", {}))
         admission = AdmissionConfig(**doc.get("admission", {}))
+        plane = PlaneConfig(**doc.get("plane", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -515,6 +553,7 @@ class Config:
             catchup=catchup,
             batching=batching,
             admission=admission,
+            plane=plane,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

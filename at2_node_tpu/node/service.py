@@ -465,20 +465,50 @@ class Service(At2Servicer):
                     on_frame=on_frame,
                     clock=service.clock,
                 )
-            service.broadcast = Broadcast(
-                config.sign_key,
-                service.mesh,
-                service.verifier,
-                echo_threshold=config.echo_threshold,
-                ready_threshold=config.ready_threshold,
-                registry=service.registry,
-                trace=service.tx_trace,
-                recorder=(
-                    service.recorder if service.recorder.enabled else None
-                ),
-                clock=service.clock,
-                phases=service.phases,
-            )
+            plane_cfg = config.plane
+            if plane_cfg.shards > 1:
+                # sharded broadcast plane (broadcast/shards.py). Under a
+                # non-system clock the executor is forced inline: the sim
+                # owns the schedule and shard threads would race it —
+                # inline keeps shards=N byte-identical on the wire.
+                from ..broadcast.shards import ShardedPlane
+                from ..clock import SYSTEM_CLOCK
+
+                executor = plane_cfg.executor
+                if service.clock is not SYSTEM_CLOCK:
+                    executor = "inline"
+                service.broadcast = ShardedPlane(
+                    config.sign_key,
+                    service.mesh,
+                    service.verifier,
+                    shards=plane_cfg.shards,
+                    executor=executor,
+                    workers=plane_cfg.workers,
+                    echo_threshold=config.echo_threshold,
+                    ready_threshold=config.ready_threshold,
+                    registry=service.registry,
+                    trace=service.tx_trace,
+                    recorder=(
+                        service.recorder if service.recorder.enabled else None
+                    ),
+                    clock=service.clock,
+                    phases=service.phases,
+                )
+            else:
+                service.broadcast = Broadcast(
+                    config.sign_key,
+                    service.mesh,
+                    service.verifier,
+                    echo_threshold=config.echo_threshold,
+                    ready_threshold=config.ready_threshold,
+                    registry=service.registry,
+                    trace=service.tx_trace,
+                    recorder=(
+                        service.recorder if service.recorder.enabled else None
+                    ),
+                    clock=service.clock,
+                    phases=service.phases,
+                )
             # flight-record the verifier's flush decisions too (duck-typed
             # attach; a SHARED verifier keeps its first owner's recorder)
             if (
@@ -1284,6 +1314,13 @@ class Service(At2Servicer):
             "recovery": self.recovery.to_dict(self.clock.monotonic()),
             "membership": (
                 self.membership.stats() if self.membership else {}
+            ),
+            # sharded-plane block (tools/top.py `shards` column); the
+            # monolithic plane has no plane_info and reports shards=1
+            "plane": (
+                self.broadcast.plane_info()
+                if hasattr(self.broadcast, "plane_info")
+                else {"shards": 1, "executor": "loop"}
             ),
         }
 

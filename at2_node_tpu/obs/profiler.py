@@ -39,6 +39,7 @@ phase vocabulary and the overhead budget.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import platform
 import subprocess
@@ -55,6 +56,7 @@ __all__ = [
     "PHASE_BOUNDS",
     "EventLoopLagProbe",
     "PhaseAccounting",
+    "ShardPhaseView",
     "StackSampler",
     "build_info",
 ]
@@ -104,6 +106,18 @@ class PhaseAccounting:
     Counters are exact across threads (registry Counter.inc is
     lock-protected), which is what makes the decomposition shares
     trustworthy when 16 workers mark concurrently.
+
+    The ``plane_total`` denominator goes through ``begin_plane`` /
+    ``end_plane`` instead of a bare ``add_ns``: a drain cycle can
+    re-enter the plane within the same logical task (e.g. a verifier
+    fallback path kicked via ``rlc_ready_or_kick`` that pumps the inbox
+    again), and the naive span-per-call accounting counted the nested
+    cycle's wall time TWICE — once in its own span and once inside the
+    outer one — inflating the denominator and deflating coverage. The
+    guard is a contextvar depth counter, which gives exactly the right
+    isolation on both runtimes: per-Task on the event loop (two worker
+    tasks interleaving on one thread still account their own cycles) and
+    per-thread on shard executors.
     """
 
     __slots__ = ("_counters", "_hists")
@@ -149,9 +163,90 @@ class PhaseAccounting:
             self._counters[phase].inc(ns)
             self._hists[phase].observe(ns * 1e-9)
 
+    def begin_plane(self) -> int:
+        """Open a plane drain cycle. Returns the cycle-open timestamp,
+        or -1 when this context is already inside a cycle (the nested
+        cycle's span must NOT be added to ``plane_total`` again)."""
+        depth = _plane_depth.get()
+        _plane_depth.set(depth + 1)
+        return time.perf_counter_ns() if depth == 0 else -1
+
+    def end_plane(self, t0: int) -> None:
+        """Close the cycle opened by the matching :meth:`begin_plane`;
+        accounts ``plane_total`` only for the outermost cycle."""
+        depth = _plane_depth.get()
+        if depth > 0:
+            _plane_depth.set(depth - 1)
+        if t0 >= 0:
+            self.add_ns("plane_total", time.perf_counter_ns() - t0)
+
+    def shard_view(self, shard_id: int, registry: Registry) -> "ShardPhaseView":
+        """A per-shard facade over this accounting: same marking API,
+        but the six plane leaf phases additionally land in
+        ``phase_<p>_shard<k>_ns`` counters on ``registry`` so /metrics
+        can show where each shard's time goes. Base counters still get
+        every mark — aggregate coverage math is unchanged."""
+        return ShardPhaseView(self, shard_id, registry)
+
     def totals(self) -> dict[str, int]:
         """{phase: accumulated ns} — the raw decomposition vector."""
         return {p: c.value for p, c in self._counters.items()}
+
+
+# Depth of nested plane drain cycles in the current context. Module-level
+# (not per-instance) so a shard core's view and the owner's accounting
+# agree on what "inside a cycle" means; contextvars give per-Task
+# isolation on the loop and per-thread isolation on shard executors.
+_plane_depth: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "at2_plane_depth", default=0
+)
+
+
+class ShardPhaseView:
+    """Shard-labeled facade over a shared :class:`PhaseAccounting` (see
+    :meth:`PhaseAccounting.shard_view`). Leaf-phase marks dual-write to
+    the base counters and the shard's own ``phase_<p>_shard<k>_ns``
+    counters; everything else delegates."""
+
+    __slots__ = ("_base", "shard_id", "_shard_counters")
+
+    def __init__(
+        self, base: PhaseAccounting, shard_id: int, registry: Registry
+    ) -> None:
+        self._base = base
+        self.shard_id = shard_id
+        self._shard_counters = {
+            p: registry.counter(
+                f"phase_{p}_shard{shard_id}_ns",
+                f"elapsed ns accounted to phase {p} on plane shard {shard_id}",
+            )
+            for p in PLANE_LEAF_PHASES
+        }
+
+    t = staticmethod(PhaseAccounting.t)
+
+    def add(self, phase: str, t0: int) -> int:
+        t1 = self._base.add(phase, t0)
+        dt = t1 - t0
+        sc = self._shard_counters.get(phase)
+        if sc is not None and dt > 0:
+            sc.inc(dt)
+        return t1
+
+    def add_ns(self, phase: str, ns: int) -> None:
+        self._base.add_ns(phase, ns)
+        sc = self._shard_counters.get(phase)
+        if sc is not None and ns > 0:
+            sc.inc(ns)
+
+    def begin_plane(self) -> int:
+        return self._base.begin_plane()
+
+    def end_plane(self, t0: int) -> None:
+        self._base.end_plane(t0)
+
+    def totals(self) -> dict[str, int]:
+        return self._base.totals()
 
 
 # --------------------------------------------------------------------------

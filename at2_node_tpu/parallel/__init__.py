@@ -11,10 +11,25 @@ __all__ = [
     "make_mesh",
     "pool_bucket_for",
     "verify_batch_sharded",
+    "InlinePlaneExecutor",
+    "SPSCQueue",
+    "ThreadPlaneExecutor",
+    "make_plane_executor",
 ]
+
+_PLANE = {
+    "InlinePlaneExecutor",
+    "SPSCQueue",
+    "ThreadPlaneExecutor",
+    "make_plane_executor",
+}
 
 
 def __getattr__(name):
+    if name in _PLANE:
+        from . import plane
+
+        return getattr(plane, name)
     if name in __all__:
         from . import pool
 

@@ -1,0 +1,153 @@
+"""PlaneExecutor seam: where sharded broadcast drain work runs.
+
+The sharded plane (broadcast/shards.py) partitions slot state by origin
+key and needs two things from the runtime: a place to run each shard's
+drain closure, and a bounded handoff lane for the effects a shard
+produces (outbound frames, delivered payloads, stall kicks) that must be
+applied on the owner event loop. This module provides both behind a
+seam small enough that the sim can substitute a synchronous executor
+and keep the whole plane deterministic:
+
+- ``InlinePlaneExecutor`` runs shard closures synchronously on the
+  caller. One logical worker, no threads, no reordering — this is what
+  ``SimScheduler``-driven nodes use, and why the same-seed campaign
+  hash is identical at shards=1 and shards=4.
+- ``ThreadPlaneExecutor`` pins one OS thread per shard (single-thread
+  pool each, so shard state is confined to exactly one thread for its
+  lifetime). Python-level work still serializes on the GIL; the
+  scaling comes from the native quorum/parse kernels releasing it.
+  Process or subinterpreter executors slot in behind the same protocol
+  later without touching the plane.
+- ``SPSCQueue`` is the bounded single-producer single-consumer lane a
+  shard uses to hand effects back to the owner loop. Bounded so a
+  stalled owner exerts backpressure instead of growing without limit;
+  instrumented so /metrics can show depth and handoff latency.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class SPSCQueue:
+    """Bounded single-producer single-consumer handoff queue.
+
+    One shard thread puts, the owner loop drains. Under CPython's GIL a
+    deque's append/popleft are atomic, so no lock is needed for the
+    1-producer/1-consumer discipline this class documents. ``put``
+    returns False when the queue is full — the producer decides whether
+    to spin, drop, or run the effect degraded; it must not block the
+    shard drain loop on the owner.
+    """
+
+    __slots__ = ("_q", "_cap", "_dropped")
+
+    def __init__(self, capacity: int = 4096):
+        if capacity <= 0:
+            raise ValueError("SPSCQueue capacity must be positive")
+        self._q: deque = deque()
+        self._cap = capacity
+        self._dropped = 0
+
+    def put(self, item: Any) -> bool:
+        if len(self._q) >= self._cap:
+            self._dropped += 1
+            return False
+        self._q.append((time.perf_counter_ns(), item))
+        return True
+
+    def drain(self, max_items: int = 0) -> Tuple[List[Any], int]:
+        """Pop up to ``max_items`` entries (0 = all currently visible).
+
+        Returns ``(items, max_handoff_ns)`` where the second element is
+        the oldest enqueue-to-drain latency seen in this drain — the
+        number /metrics reports as ``plane_shard_handoff_ns``.
+        """
+        out: List[Any] = []
+        worst = 0
+        now = time.perf_counter_ns()
+        n = len(self._q) if max_items <= 0 else min(max_items, len(self._q))
+        for _ in range(n):
+            try:
+                t0, item = self._q.popleft()
+            except IndexError:  # racing producer-side len() snapshot
+                break
+            dt = now - t0
+            if dt > worst:
+                worst = dt
+            out.append(item)
+        return out, worst
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+
+class InlinePlaneExecutor:
+    """Synchronous executor: shard closures run on the caller, in call
+    order. This is the deterministic path — the sim drives every shard
+    from one logical worker, so wire behavior is byte-identical to the
+    monolithic plane."""
+
+    name = "inline"
+
+    def __init__(self, shards: int = 1):
+        self.shards = shards
+
+    def submit(
+        self, shard_id: int, fn: Callable[..., Any], *args: Any
+    ) -> "concurrent.futures.Future":
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        try:
+            fut.set_result(fn(*args))
+        except BaseException as exc:  # noqa: BLE001 - mirrored to future
+            fut.set_exception(exc)
+        return fut
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ThreadPlaneExecutor:
+    """One OS thread per shard. Each shard gets its own single-thread
+    pool so its slot state is only ever touched from that thread —
+    confinement, not locking, is the memory model. The owner loop
+    awaits the returned futures (wrapped via asyncio) and applies the
+    shard's queued effects afterwards."""
+
+    name = "thread"
+
+    def __init__(self, shards: int):
+        if shards <= 0:
+            raise ValueError("ThreadPlaneExecutor needs >= 1 shard")
+        self.shards = shards
+        self._pools = [
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"plane-shard-{i}"
+            )
+            for i in range(shards)
+        ]
+
+    def submit(
+        self, shard_id: int, fn: Callable[..., Any], *args: Any
+    ) -> "concurrent.futures.Future":
+        return self._pools[shard_id].submit(fn, *args)
+
+    def shutdown(self) -> None:
+        for p in self._pools:
+            p.shutdown(wait=False, cancel_futures=True)
+
+
+def make_plane_executor(kind: str, shards: int):
+    """Factory behind the config seam: ``[plane] executor = ...``."""
+    if kind == "inline":
+        return InlinePlaneExecutor(shards)
+    if kind == "thread":
+        return ThreadPlaneExecutor(shards)
+    raise ValueError(f"unknown plane executor {kind!r}")

@@ -1138,6 +1138,7 @@ def run_campaign(
     broker: bool = False,
     durability: bool = False,
     salting: bool = False,
+    config_overrides: Optional[dict] = None,
 ) -> dict:
     """``episodes`` independent seeded episodes; per-episode seeds derive
     from the campaign seed, failures carry their exact replay recipe
@@ -1164,6 +1165,7 @@ def run_campaign(
             broker=broker,
             durability=durability,
             salting=salting,
+            config_overrides=config_overrides,
         )
         if result.violations and minimize:
             result.minimized = minimize_events(
@@ -1180,6 +1182,7 @@ def run_campaign(
                         broker=broker,
                         durability=durability,
                         salting=salting,
+                        config_overrides=config_overrides,
                     ).violations
                 ),
             )

@@ -111,8 +111,19 @@ class SimNet:
         membership_grace: Optional[float] = None,
         verifier_mode: str = "auto",
         rlc_min_batch: int = 128,
+        plane_shards: int = 1,
         **config_overrides,
     ) -> None:
+        # convenience for the shard-determinism campaigns: shards > 1
+        # becomes a [plane] table on every node, executor pinned inline
+        # (Service forces inline under the sim clock anyway; pinning here
+        # keeps the dumped config honest about what actually runs)
+        if plane_shards > 1 and "plane" not in config_overrides:
+            from ..node.config import PlaneConfig
+
+            config_overrides["plane"] = PlaneConfig(
+                shards=plane_shards, executor="inline"
+            )
         self.n = n
         self.f = f
         self.seed = seed

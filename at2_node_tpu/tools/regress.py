@@ -49,6 +49,7 @@ _SINGLE_FILES = (
     "BENCH_DURABILITY.json",
     "BENCH_SCENARIOS.json",
     "BENCH_OBS_OVERHEAD.json",
+    "BENCH_PLANE_SHARDS.json",
 )
 
 
@@ -206,6 +207,48 @@ def load_aggregate(name: str, doc: dict) -> List[dict]:
     return rows
 
 
+def load_plane_shards(name: str, doc: dict) -> List[dict]:
+    """BENCH_PLANE_SHARDS.json: the sharded-plane scaling grid. The
+    comparability key carries ``host_cores`` — a 1-core capture and a
+    4-core capture of the same shard count measure different things and
+    must never diff against each other."""
+    _require(doc, "config", name)
+    runs = _require(doc, "runs", name, dict)
+    _require(doc, "latest", name, str)
+    rows: List[dict] = []
+    for order, cap in enumerate(sorted(runs)):
+        run = _require(runs, cap, f"{name}.runs", dict)
+        grid = _require(run, "grid", f"{name}.runs.{cap}", list)
+        for cell in grid:
+            path = f"{name}.runs.{cap}.grid[]"
+            shards = int(_num(cell, "shards", path))
+            cores = int(_num(cell, "host_cores", path))
+            comp = (
+                f"cores={cores} batch={int(_num(cell, 'batch', path))} "
+                f"verifier={cell.get('verifier')} {_tunnel_tag(cell, run)}"
+            )
+            rows.append(
+                _row(
+                    f"plane_shards/shards{shards}.best_tx_per_sec",
+                    cap,
+                    order,
+                    _num(cell, "best_tx_per_sec", path),
+                    comp,
+                )
+            )
+            if shards != 1:
+                rows.append(
+                    _row(
+                        f"plane_shards/shards{shards}.speedup_vs_1",
+                        cap,
+                        order,
+                        _num(cell, "speedup_vs_1", path),
+                        comp,
+                    )
+                )
+    return rows
+
+
 def load_pipeline(name: str, doc: dict) -> List[dict]:
     vg = _require(doc, "verify_grid", name, dict)
     grid = _require(vg, "grid", f"{name}.verify_grid", dict)
@@ -350,6 +393,7 @@ _SINGLE_LOADERS = {
     "BENCH_DURABILITY.json": load_durability,
     "BENCH_SCENARIOS.json": load_scenarios,
     "BENCH_OBS_OVERHEAD.json": load_obs_overhead,
+    "BENCH_PLANE_SHARDS.json": load_plane_shards,
 }
 
 _RUN_LOADERS = {

@@ -79,6 +79,12 @@ def main(argv=None) -> int:
                         "while the shared verifier runs amortized (RLC) "
                         "verification; invariants add bounded "
                         "amortization loss + router convergence")
+    parser.add_argument("--plane-shards", type=int, default=1,
+                        metavar="N",
+                        help="run every episode with the broadcast plane "
+                        "sharded N ways (inline executor; the campaign "
+                        "hash must match the shards=1 hash — shard count "
+                        "is unobservable on the sim wire)")
     parser.add_argument("--minimize", action="store_true",
                         help="greedily minimize each failing schedule")
     parser.add_argument("--trace-out", metavar="PATH",
@@ -120,6 +126,11 @@ def main(argv=None) -> int:
         broker=args.broker,
         durability=args.durability,
         salting=args.salting,
+        config_overrides=(
+            {"plane_shards": args.plane_shards}
+            if args.plane_shards > 1
+            else None
+        ),
     )
     campaign["wall_seconds"] = round(time.monotonic() - wall0, 2)
     campaign["argv"] = sys.argv[1:]

@@ -117,7 +117,7 @@ def render_frame(rows, now: float, prev) -> str:
         f"{'rej':>6}{'vrf occ':>9}{'vmode':>10}{'q-wait p99':>12}"
         f"{'lag p99':>9}"
         f"{'backlog':>9}{'dstl rx/ms/dd':>15}{'peers':>7}"
-        f"{'epoch':>7}  {'recovery':<16}"
+        f"{'shards':>8}{'epoch':>7}  {'recovery':<16}"
     )
     lines = []
     # fleet build line: every distinct (git SHA, config hash) the nodes
@@ -181,6 +181,7 @@ def render_frame(rows, now: float, prev) -> str:
                 f"{pend:>9}"
                 f"{drops:>15}"
                 f"{_num(stats, 'broker_registrations'):>7}"
+                f"{'-':>8}"
                 f"{'-':>7}  {'-':<16}"
             )
             continue
@@ -226,6 +227,15 @@ def render_frame(rows, now: float, prev) -> str:
             f"{_num(stats, 'directory_misses')}/"
             f"{_num(stats, 'dedup_drops')}"
         )
+        # broadcast-plane sharding (statusz "plane" block): shard count
+        # plus executor initial — "1/l" is the monolithic loop plane,
+        # "4/t" four shard threads (broadcast/shards.py)
+        plane = sz.get("plane", {})
+        shards_s = (
+            f"{_num(plane, 'shards')}/{str(plane.get('executor', '?'))[:1]}"
+            if plane
+            else "-"
+        )
         lines.append(
             f"{addr:<22}"
             f"{health.get('status', '?'):<11}"
@@ -244,6 +254,7 @@ def render_frame(rows, now: float, prev) -> str:
             f"{dstl_s:>15}"
             f"{_num(health, 'peers_connected'):>4}/"
             f"{_num(health, 'peers_configured'):<2}"
+            f"{shards_s:>8}"
             f"{_num(health, 'epoch'):>7}  "
             f"{_recovery_cell(sz.get('recovery', {})):<16}"
         )

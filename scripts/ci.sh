@@ -222,6 +222,49 @@ echo "== profiler smoke gate =="
 python -m at2_node_tpu.tools.plane_bench --smoke-profile --nodes 3 \
     --txs 200 --out /dev/null
 
+echo "== sharded-plane gate =="
+# Sharded broadcast plane (ISSUE 12): the invariance suite first (named
+# explicitly so a marker/collection change can never drop it), then the
+# shard-determinism contract straight from the episode driver — the
+# same seed must produce ONE campaign hash whether the plane runs
+# monolithic or split across 4 shards, and reproduce it run to run.
+python -m pytest tests/test_plane_shards.py -q
+python - <<'EOF'
+from at2_node_tpu.sim.campaign import run_episode
+
+kw = dict(n_events=10, duration=8.0, settle_horizon=60.0)
+mono = run_episode(21, **kw)
+s4a = run_episode(21, config_overrides={"plane_shards": 4}, **kw)
+s4b = run_episode(21, config_overrides={"plane_shards": 4}, **kw)
+assert s4a.trace_hash == s4b.trace_hash, "shards=4 not self-deterministic"
+assert mono.trace_hash == s4a.trace_hash, (
+    f"shard count observable on the wire: {mono.trace_hash[:12]} != "
+    f"{s4a.trace_hash[:12]}"
+)
+print("shard-invariant campaign hash:", mono.trace_hash[:16])
+EOF
+# 2-core scaling smoke: threaded shards must buy >= 1.5x plane
+# throughput over the monolithic loop when there are real cores to
+# spread across. A 1-core host cannot measure scaling — skip (the
+# banked BENCH_PLANE_SHARDS.json grid is the tracked artifact there).
+if [ "$(nproc)" -ge 2 ]; then
+  python -m at2_node_tpu.tools.plane_bench --shards-grid 1,2 --cores 2 \
+      --nodes 3 --txs 300 --grid-repeat 2 --no-bank \
+      --out /tmp/_plane_shards_smoke.json
+  python - <<'EOF'
+import json
+
+doc = json.load(open("/tmp/_plane_shards_smoke.json"))
+speedup = doc["summary"]["peak_speedup_vs_1"]
+assert speedup >= 1.5, (
+    f"sharded plane speedup {speedup}x < 1.5x on 2 cores"
+)
+print(f"sharded plane 2-core speedup: {speedup}x")
+EOF
+else
+  echo "single-core host: skipping the 2-core scaling smoke"
+fi
+
 echo "== bench-regression sentry gate =="
 # regress.py diffs every banked BENCH_*/SCALE_*/MULTICHIP_* artifact
 # against its nearest COMPARABLE capture (tunnel/device state must

@@ -108,6 +108,73 @@ class TestPhaseAccounting:
             assert off_plane in PHASES
             assert off_plane not in PLANE_LEAF_PHASES
 
+    def test_plane_total_reentrancy_accounts_once(self):
+        """A drain cycle that re-enters the plane in-context (e.g. an
+        rlc_ready_or_kick fallback driving another drain) must account
+        its span ONCE: the nested begin_plane returns the -1 sentinel
+        and its end_plane adds nothing."""
+        reg = Registry()
+        ph = PhaseAccounting(reg)
+        t_outer = ph.begin_plane()
+        assert t_outer >= 0
+        t_inner = ph.begin_plane()  # re-entrant: must not double-count
+        assert t_inner == -1
+        ph.end_plane(t_inner)  # no-op account
+        assert ph.totals()["plane_total"] == 0
+        ph.end_plane(t_outer)
+        outer_total = ph.totals()["plane_total"]
+        assert outer_total > 0
+        # fully unwound: the next cycle accounts again, from zero depth
+        t2 = ph.begin_plane()
+        assert t2 >= 0
+        ph.end_plane(t2)
+        assert ph.totals()["plane_total"] > outer_total
+
+    def test_plane_total_depth_is_thread_local(self):
+        """Shard executor threads each carry their own re-entrancy depth
+        (contextvars): one thread's open plane span must not turn
+        another thread's begin_plane into the nested sentinel."""
+        reg = Registry()
+        ph = PhaseAccounting(reg)
+        t_outer = ph.begin_plane()
+        assert t_outer >= 0
+        seen = []
+
+        def shard_cycle():
+            t = ph.begin_plane()
+            seen.append(t)
+            ph.end_plane(t)
+
+        th = threading.Thread(target=shard_cycle)
+        th.start()
+        th.join()
+        assert seen and seen[0] >= 0  # NOT the nested sentinel
+        ph.end_plane(t_outer)
+
+    def test_shard_view_dual_writes(self):
+        """ShardPhaseView: leaf marks land in BOTH the base aggregate
+        (decomposition shares stay plane-wide) and the per-shard
+        counter (phase_<p>_shard<i>_ns) on the plane registry."""
+        base_reg = Registry()
+        ph = PhaseAccounting(base_reg)
+        plane_reg = Registry()
+        v0 = ph.shard_view(0, plane_reg)
+        v1 = ph.shard_view(1, plane_reg)
+        v0.add_ns("echo_apply", 7)
+        v1.add_ns("echo_apply", 5)
+        v1.add_ns("ready_deliver", 3)
+        assert ph.totals()["echo_apply"] == 12  # aggregate spans shards
+        snap = plane_reg.snapshot()
+        assert snap["phase_echo_apply_shard0_ns"] == 7
+        assert snap["phase_echo_apply_shard1_ns"] == 5
+        assert snap["phase_ready_deliver_shard1_ns"] == 3
+        # begin/end_plane delegate to the base accounting (plane_total
+        # stays an owner-loop aggregate, never per-shard)
+        t = v0.begin_plane()
+        assert v1.begin_plane() == -1  # same context: depth is shared
+        v0.end_plane(t)
+        assert ph.totals()["plane_total"] > 0
+
 
 # ------------------------------------------------------------ stack sampler
 
