@@ -146,6 +146,7 @@ class ShardedPlane:
         recorder=None,
         clock=None,
         phases=None,
+        overlap_ready: bool = False,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
         from ..obs.registry import Registry
@@ -222,6 +223,7 @@ class ShardedPlane:
                     if phases is not None
                     else None
                 ),
+                overlap_ready=overlap_ready,
             )
             core.stats = self.stats  # ONE aggregate counter group
             if self._inline:

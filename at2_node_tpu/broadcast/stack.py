@@ -348,6 +348,7 @@ class _BatchState:
         "delivered_all",
         "retired",
         "nbits",
+        "echo_q_marked",
     )
 
     def __init__(self, now: float) -> None:
@@ -383,6 +384,7 @@ class _BatchState:
         # and must not count as stalled (see _maybe_retire_batch)
         self.retired = False
         self.nbits = 0  # widest entry count seen (content or bitmap bound)
+        self.echo_q_marked = 0  # entries already echo_quorum-marked (trace)
 
 
 class _SlotState:
@@ -435,6 +437,8 @@ class Broadcast:
     recorder = None
     # same contract for the plane time-accounting seam (obs/profiler.py)
     phases = None
+    # same contract for the [wan] echo/ready phase-piggyback knob
+    overlap_ready = False
 
     def __init__(
         self,
@@ -449,6 +453,7 @@ class Broadcast:
         recorder=None,
         clock=None,
         phases=None,
+        overlap_ready: bool = False,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
 
@@ -562,6 +567,17 @@ class Broadcast:
         # plane time-accounting (obs/profiler.py PhaseAccounting); same
         # ``is not None`` guard discipline at every marked segment
         self.phases = phases
+        # [wan] overlap_ready: emit Ready in the SAME frame as Echo
+        # (phase piggybacking) instead of waiting out the echo-quorum
+        # round trip. Safety is carried by what this knob does NOT
+        # change: the per-slot single-Ready binding (ready_hash is set
+        # exactly once, all sends go through _send_attestation's
+        # watermark floors) and the delivery gate (ready quorum AND own
+        # ready sent AND content known). What it relaxes is only the
+        # scheduling claim "own Ready implies a locally-observed echo
+        # quorum" — an opt-in latency/ordering trade, default off so the
+        # wire schedule (and every same-seed sim hash) is unchanged.
+        self.overlap_ready = overlap_ready
         self.registry.gauge(
             "slots_undelivered", "live undelivered broadcast slots",
             fn=lambda: self._undelivered,
@@ -1267,6 +1283,16 @@ class Broadcast:
                 self._send_attestation(
                     ECHO, payload.sender, payload.sequence, chash
                 )
+                if self.overlap_ready and not state.ready_sent:
+                    # [wan] phase piggyback: bind and send the Ready in
+                    # the same frame as the Echo (mesh coalescing packs
+                    # both into one wire frame), collapsing the serial
+                    # echo-quorum round trip out of the critical path
+                    state.ready_sent = True
+                    state.ready_hash = chash
+                    self._send_attestation(
+                        READY, payload.sender, payload.sequence, chash
+                    )
         if ph is not None:
             t0 = ph.add("echo_apply", t0)
         self._advance(slot, state, chash)
@@ -1526,6 +1552,19 @@ class Broadcast:
                 self._send_batch_attestation(
                     BATCH_ECHO, slot, chash, bits, batch.count
                 )
+                if self.overlap_ready and state.ready_hash is None:
+                    # [wan] phase piggyback, batched plane: bind the
+                    # slot's single Ready hash now and ready exactly the
+                    # entries just echoed; _advance_batch later tops up
+                    # ready_sent_bits cumulatively as more entries
+                    # quorate (to_ready masks off these initial bits)
+                    state.ready_hash = chash
+                    state.ready_sent_bits |= bits
+                    if self.trace is not None:
+                        self._stamp_batch_marker(batch, bits, "ready_sent")
+                    self._send_batch_attestation(
+                        BATCH_READY, slot, chash, bits, batch.count
+                    )
         if ph is not None:
             t0 = ph.add("echo_apply", t0)
         self._advance_batch(slot, state, chash)
@@ -1623,6 +1662,17 @@ class Broadcast:
         else:
             self.mesh.broadcast(att.encode())
 
+    def _stamp_batch_marker(self, batch: TxBatch, bits: int, stage: str) -> None:
+        """Stamp an order-free phase marker (obs/trace.py PHASE_MARKERS)
+        on every set-bit entry of ``batch`` — unsampled keys cost one
+        dict miss each."""
+        entries = batch.entries()
+        while bits:
+            lsb = bits & -bits
+            p = entries[lsb.bit_length() - 1]
+            self.trace.stamp((p.sender, p.sequence), stage)
+            bits ^= lsb
+
     def _advance_batch(self, slot, state: _BatchState, chash: bytes) -> None:
         """Drive per-entry phase transitions for one batch content."""
         batch = state.contents.get(chash)
@@ -1660,6 +1710,11 @@ class Broadcast:
         # Slot-level binding (per-tx parity, _SlotState.ready_sent): this
         # node signs Ready for at most ONE content per slot — an honest
         # node must never be wire-indistinguishable from an equivocator.
+        if self.trace is not None and batch is not None:
+            new_eq = echo_q & ~state.echo_q_marked & full
+            if new_eq:
+                state.echo_q_marked |= new_eq
+                self._stamp_batch_marker(batch, new_eq, "echo_quorum")
         wants_ready = (echo_q | ready_q) & full
         if state.ready_hash is None and wants_ready:
             state.ready_hash = chash
@@ -1667,6 +1722,8 @@ class Broadcast:
             to_ready = wants_ready & ~state.ready_sent_bits
             if to_ready:
                 state.ready_sent_bits |= to_ready
+                if self.trace is not None and batch is not None:
+                    self._stamp_batch_marker(batch, to_ready, "ready_sent")
                 self._send_batch_attestation(
                     BATCH_READY, slot, chash, state.ready_sent_bits, nbits
                 )
@@ -1895,6 +1952,10 @@ class Broadcast:
         sig = self.keypair.sign(Attestation.signing_bytes(phase, sender, sequence, chash))
         if self.on_attest is not None:
             self.on_attest(phase, sender, sequence, chash)
+        if phase == READY and self.trace is not None:
+            # order-free phase marker (obs/trace.py PHASE_MARKERS): with
+            # overlap_ready this lands BEFORE echo_quorum
+            self.trace.stamp((sender, sequence), "ready_sent")
         att = Attestation(phase, self.keypair.public, sender, sequence, chash, sig)
         if self.recorder is not None:
             self.recorder.record(
@@ -1917,6 +1978,8 @@ class Broadcast:
             and len(state.echoes[chash]) >= self.echo_threshold
         ):
             state.sieve_delivered = True
+            if self.trace is not None:
+                self.trace.stamp(slot, "echo_quorum")
             if self.recorder is not None:
                 self.recorder.record("echo_quorum", (slot[1],))
             if not state.ready_sent:

@@ -57,6 +57,11 @@ PENDING_CAP = 1 << 16
 # RESOURCE_EXHAUSTED, not after.
 BACKPRESSURE_FRAC = 0.75
 
+# [wan] eager flush never shrinks the deadline below this fraction of
+# the configured window: a lone straggler still gets a quarter window
+# of batching opportunity instead of flushing as a singleton frame.
+EAGER_MIN_FRAC = 0.25
+
 
 class Broker(At2Servicer):
     """One broker. `await Broker.start(...)`, then `serve_forever`."""
@@ -67,6 +72,7 @@ class Broker(At2Servicer):
         *,
         max_entries: int = distill.DISTILL_MAX_ENTRIES,
         window: float = 0.005,
+        eager: bool = False,
         clock=None,
         trace_sample: int = 1,
         recorder_cap: int = 2048,
@@ -80,6 +86,14 @@ class Broker(At2Servicer):
         self.node_uri = node_uri
         self.max_entries = max_entries
         self.window = window
+        # [wan] eager flush: anchor the flush deadline to the FIRST entry
+        # of the pending batch instead of restarting a full window on
+        # every delayed-flush cycle, and shrink it as the buffer fills —
+        # a near-full buffer has little batching left to gain from
+        # waiting, so it ships early. Off (default) keeps the fixed
+        # window verbatim.
+        self.eager = eager
+        self._first_at = 0.0
         self.clock = SYSTEM_CLOCK if clock is None else clock
         self._channel = grpc.aio.insecure_channel(_target(node_uri))
         self._stub = At2Stub(self._channel)
@@ -103,6 +117,7 @@ class Broker(At2Servicer):
                 "broker_overflow_drops",  # refused: buffer at PENDING_CAP
                 "broker_forward_errors",  # SendDistilledBatch RPC failures
                 "broker_registrations",  # Register round-trips to the node
+                "broker_eager_flushes",  # flushes taken on the eager path
             )
         )
         # seconds from flush trigger to frame handed to the RPC stack:
@@ -154,13 +169,15 @@ class Broker(At2Servicer):
         *,
         max_entries: int = distill.DISTILL_MAX_ENTRIES,
         window: float = 0.005,
+        eager: bool = False,
         clock=None,
     ) -> "Broker":
         """Bring up a broker serving `at2.AT2` on ``listen`` (same
         PortMux surface as a node: native gRPC + grpc-web + GET
         /metrics), collecting for the node at ``node_uri``."""
         broker = Broker(
-            node_uri, max_entries=max_entries, window=window, clock=clock
+            node_uri, max_entries=max_entries, window=window, eager=eager,
+            clock=clock,
         )
         try:
             server = grpc.aio.server()
@@ -370,6 +387,10 @@ class Broker(At2Servicer):
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 "broker buffer full; node unreachable or lagging",
             )
+        if not self._buf:
+            # empty -> non-empty transition: this batch's age clock
+            # starts now (the eager deadline is measured from here)
+            self._first_at = self.clock.monotonic()
         self._buf.extend(entries)
         self.stats["broker_entries_rx"] += len(entries)
         # the raw request still has the sender pubkey in hand here, so
@@ -385,7 +406,22 @@ class Broker(At2Servicer):
 
     async def _delayed_flush(self) -> None:
         while True:
-            await self.clock.sleep(self.window)
+            if self.eager:
+                # queue-depth-adaptive deadline anchored to the batch's
+                # first entry: deep buffers flush sooner (less batching
+                # upside left), and time already spent buffered counts
+                # against the deadline instead of restarting it
+                depth = len(self._buf)
+                frac = max(
+                    EAGER_MIN_FRAC, 1.0 - depth / self.max_entries
+                )
+                elapsed = self.clock.monotonic() - self._first_at
+                delay = frac * self.window - elapsed
+                if delay > 0.0:
+                    await self.clock.sleep(delay)
+                self.stats["broker_eager_flushes"] += 1
+            else:
+                await self.clock.sleep(self.window)
             await self._flush()
             if not self._buf:
                 return

@@ -5,9 +5,10 @@ Equivalent of drop's `System` / `SystemManager` / `NetworkSender`
 listener, dial every configured peer, and expose send/broadcast keyed by
 peer identity. Improvements over the reference consciously taken:
 
-* dropped connections ARE re-dialed with exponential backoff — the
-  reference leaves this as "TODO readd connections if dropped"
-  (`rpc.rs:87`);
+* dropped connections ARE re-dialed with jittered exponential backoff —
+  the reference leaves this as "TODO readd connections if dropped"
+  (`rpc.rs:87`); successful re-dials after a drop are counted as
+  `peer_reconnects` (distinct from `redials`, which counts the drops);
 * inbound connections from unknown exchange keys are rejected at the
   handshake boundary (the reference relies on drop's Exchanger for the
   same property [dep-inferred]).
@@ -64,6 +65,7 @@ class Peer:
     address: str  # "host:port" of the peer's node plane
     exchange_public: bytes  # 32-byte X25519 key (channel identity)
     sign_public: bytes  # 32-byte ed25519 key (Echo/Ready signing identity)
+    region: str = ""  # optional region hint ([wan] fanout ordering)
 
     def host_port(self) -> tuple:
         host, _, port = self.address.rpartition(":")
@@ -80,12 +82,20 @@ class Mesh:
         peers: Iterable[Peer],
         on_frame: Callable[[Peer, bytes], Awaitable[None]],
         clock=None,
+        region_fanout: bool = False,
+        region: str = "",
     ) -> None:
         from ..clock import SYSTEM_CLOCK
 
         self.listen_addr = listen_addr
         self.keypair = keypair
         self.clock = SYSTEM_CLOCK if clock is None else clock
+        # [wan] region-aware fanout: when on, broadcast() walks peers
+        # nearest-first — same-region (declared hints) before far, RTT
+        # EWMA (fed from dial timing) as the fine order within each tier
+        self.region_fanout = region_fanout
+        self.region = region
+        self._rtt_ewma: Dict[bytes, float] = {}
         self.peers = [p for p in peers if p.exchange_public != keypair.public]
         self.by_exchange: Dict[bytes, Peer] = {
             p.exchange_public: p for p in self.peers
@@ -110,6 +120,7 @@ class Mesh:
         # signals
         self.redials = 0  # established connections dropped + re-dialed
         self.dial_failures = 0  # connect/handshake attempts that failed
+        self.peer_reconnects = 0  # successful re-dials AFTER a drop
         self.send_overflows = 0
         self._reader_drops_closed = 0  # drops of already-closed readers
 
@@ -121,6 +132,7 @@ class Mesh:
             ),
             "redials": self.redials,
             "dial_failures": self.dial_failures,
+            "peer_reconnects": self.peer_reconnects,
             "send_overflows": self.send_overflows,
             "native_readers": len(self._native_by_fd),
             # cumulative like send_overflows: closed channels' drops must
@@ -263,23 +275,46 @@ class Mesh:
 
     def broadcast(self, frame: bytes, exclude: Iterable[bytes] = ()) -> None:
         skip = set(exclude)
-        for peer in self.peers:
+        peers = self._fanout_order() if self.region_fanout else self.peers
+        for peer in peers:
             if peer.exchange_public not in skip:
                 self.send(peer, frame)
+
+    def _fanout_order(self) -> List[Peer]:
+        """Peers nearest-first: same-region (when both hints are set)
+        before cross-region, measured RTT EWMA within each tier, config
+        order as the stable tiebreak (sort stability keeps unmeasured
+        peers in declared order)."""
+        def key(p: Peer):
+            far = 0 if (
+                self.region and p.region and p.region == self.region
+            ) else 1
+            return (far, self._rtt_ewma.get(p.exchange_public, float("inf")))
+
+        return sorted(self.peers, key=key)
 
     # -- connection maintenance -------------------------------------------
 
     async def _outbound_loop(self, peer: Peer, q: asyncio.Queue) -> None:
+        import random
+
         backoff = 0.1
         host, port = peer.host_port()
         pending: Optional[List[bytes]] = None  # batch to resend after redial
         held: Optional[bytes] = None  # message deferred to the next frame
+        dropped = False  # an established channel was lost (for reconnects)
         while not self._closed:
+            # full jitter on the backoff sleep: N peers dropping together
+            # (a switch reboot) must not re-dial in lockstep
+            def nap() -> float:
+                return backoff * random.uniform(0.5, 1.0)
+
+            dial_t0 = self.clock.monotonic()
             try:
                 channel = await transport.connect(host, port, self.keypair)
             except (OSError, transport.HandshakeError, asyncio.TimeoutError):
                 self.dial_failures += 1
-                await self.clock.sleep(backoff)
+                await self.clock.sleep(nap())
                 backoff = min(backoff * 2, 5.0)
                 continue
             if channel.peer_public != peer.exchange_public:
@@ -290,9 +325,19 @@ class Mesh:
                 )
                 self.dial_failures += 1
                 channel.close()
-                await self.clock.sleep(backoff)
+                await self.clock.sleep(nap())
                 backoff = min(backoff * 2, 5.0)
                 continue
+            # the dial (TCP connect + X25519 handshake) is a live RTT
+            # sample; EWMA it for region-aware fanout ordering
+            rtt = self.clock.monotonic() - dial_t0
+            prev_rtt = self._rtt_ewma.get(peer.exchange_public)
+            self._rtt_ewma[peer.exchange_public] = (
+                rtt if prev_rtt is None else 0.8 * prev_rtt + 0.2 * rtt
+            )
+            if dropped:
+                self.peer_reconnects += 1
+                dropped = False
             backoff = 0.1
             self._channels.add(channel)
             try:
@@ -321,6 +366,7 @@ class Mesh:
                     pending = None
             except (transport.ChannelClosed, ConnectionError):
                 self.redials += 1
+                dropped = True
                 logger.warning("connection to %s dropped; redialing", peer.address)
             finally:
                 channel.close()

@@ -363,6 +363,51 @@ class PlaneConfig:
 
 
 @dataclass
+class WanConfig:
+    """The `[wan]` table: WAN-finality latency levers (ISSUE 14).
+
+    Every knob defaults OFF so the wire schedule — and therefore every
+    same-seed sim/campaign hash — is byte-identical to a build without
+    this table. Turn them on per deployment:
+
+    ``overlap_ready`` lets a node piggyback its Ready attestation in the
+    same frame as its Echo (broadcast/stack.py), collapsing the serial
+    echo-quorum -> ready-broadcast round trip into one propagation. Safe
+    because the per-slot single-Ready binding and the delivery gate
+    (ready quorum AND own ready sent AND content known) are unchanged;
+    what is relaxed is only the non-load-bearing "Ready implies an echo
+    quorum was locally observed" ordering.
+
+    ``region_fanout`` orders broadcast fanout nearest-first: the sim
+    mesh sorts peers by fabric link latency; the real mesh sorts by a
+    per-peer RTT EWMA (fed from dial timing) with ``region`` hints as
+    the coarse tiebreak. Quorum then forms from the near-region majority
+    while far links are still in flight.
+
+    ``region`` is this node's own region hint (free-form string, ""
+    means unhinted) compared against each peer's declared region.
+
+    ``verify_ahead`` verifies parked catchup payloads DURING the quorum
+    wait when verifier occupancy is low (node/service.py), so delivery
+    after ready-quorum never blocks on signature checks.
+
+    ``eager_broker`` anchors the broker's flush deadline at the FIRST
+    buffered entry and shrinks it when the queue is shallow
+    (broker.py), so a lone WAN tx never waits out a full batch window.
+    """
+
+    overlap_ready: bool = False
+    region_fanout: bool = False
+    region: str = ""
+    verify_ahead: bool = False
+    eager_broker: bool = False
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.region, str):
+            raise ValueError("wan.region must be a string")
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -381,6 +426,7 @@ class Config:
     batching: BatchingConfig = field(default_factory=BatchingConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     plane: PlaneConfig = field(default_factory=PlaneConfig)
+    wan: WanConfig = field(default_factory=WanConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -508,6 +554,17 @@ class Config:
                 f'executor = "{pl.executor}"',
                 f"workers = {pl.workers}",
             ]
+        wa = self.wan
+        if wa != WanConfig():
+            lines += [
+                "",
+                "[wan]",
+                f"overlap_ready = {'true' if wa.overlap_ready else 'false'}",
+                f"region_fanout = {'true' if wa.region_fanout else 'false'}",
+                f'region = "{wa.region}"',
+                f"verify_ahead = {'true' if wa.verify_ahead else 'false'}",
+                f"eager_broker = {'true' if wa.eager_broker else 'false'}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -516,6 +573,8 @@ class Config:
                 f'public_key = "{peer.exchange_public.hex()}"',
                 f'sign_public_key = "{peer.sign_public.hex()}"',
             ]
+            if peer.region:
+                lines.append(f'region = "{peer.region}"')
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -531,6 +590,7 @@ class Config:
         batching = BatchingConfig(**doc.get("batching", {}))
         admission = AdmissionConfig(**doc.get("admission", {}))
         plane = PlaneConfig(**doc.get("plane", {}))
+        wan = WanConfig(**doc.get("wan", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -541,6 +601,7 @@ class Config:
                     address=n["address"],
                     exchange_public=bytes.fromhex(n["public_key"]),
                     sign_public=bytes.fromhex(n["sign_public_key"]),
+                    region=n.get("region", ""),
                 )
                 for n in doc.get("nodes", [])
             ],
@@ -554,6 +615,7 @@ class Config:
             batching=batching,
             admission=admission,
             plane=plane,
+            wan=wan,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

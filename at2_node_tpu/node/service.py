@@ -121,7 +121,7 @@ class _CatchupSession:
     broadcast workers' handler; consumed by `Service._catchup_once`."""
 
     __slots__ = ("nonce", "per_peer_cap", "indexes", "votes", "payloads",
-                 "stored_by_peer")
+                 "stored_by_peer", "prechecked")
 
     def __init__(self, nonce: int, n_peers: int) -> None:
         self.nonce = nonce
@@ -138,6 +138,10 @@ class _CatchupSession:
         # ((sender, seq), content_hash) -> the payload itself
         self.payloads: Dict[tuple, Payload] = {}
         self.stored_by_peer: Dict[bytes, int] = {}
+        # [wan] verify_ahead verdict cache: vote_key -> signature ok,
+        # filled speculatively during the quorum wait so the post-quorum
+        # apply only verifies what the speculation missed
+        self.prechecked: Dict[tuple, bool] = {}
 
 
 # module-level latch: repeated Service.start in one process (tests, bench
@@ -313,6 +317,9 @@ class Service(At2Servicer):
                 "catchup_hist_req_rx",
                 "catchup_served",
                 "catchup_throttled",
+                # [wan] verify_ahead: payloads signature-checked during
+                # the quorum wait instead of after it
+                "catchup_preverified",
             )
         )
         # per-(peer, kind) serving budgets: [window_start, used]
@@ -464,6 +471,8 @@ class Service(At2Servicer):
                     config.nodes,
                     on_frame=on_frame,
                     clock=service.clock,
+                    region_fanout=config.wan.region_fanout,
+                    region=config.wan.region,
                 )
             plane_cfg = config.plane
             if plane_cfg.shards > 1:
@@ -493,6 +502,7 @@ class Service(At2Servicer):
                     ),
                     clock=service.clock,
                     phases=service.phases,
+                    overlap_ready=config.wan.overlap_ready,
                 )
             else:
                 service.broadcast = Broadcast(
@@ -508,6 +518,7 @@ class Service(At2Servicer):
                     ),
                     clock=service.clock,
                     phases=service.phases,
+                    overlap_ready=config.wan.overlap_ready,
                 )
             # flight-record the verifier's flush decisions too (duck-typed
             # attach; a SHARED verifier keeps its first owner's recorder)
@@ -1825,17 +1836,32 @@ class Service(At2Servicer):
                 self.mesh.broadcast(
                     HistoryRequest(session.nonce, sender, lo, top).encode()
                 )
-            await self.clock.sleep(cfg.window)
-            candidates = [
-                payload
+            if self.config.wan.verify_ahead:
+                await self._verify_ahead_wait(session, cfg.window)
+            else:
+                await self.clock.sleep(cfg.window)
+            quorate = [
+                (vote_key, payload)
                 for vote_key, payload in session.payloads.items()
                 if len(session.votes.get(vote_key, ())) >= quorum
             ]
-            if not candidates:
+            if not quorate:
                 return responses, 0
-            results = await self.verifier.verify_many(
-                [(p.sender, p.to_sign(), p.signature) for p in candidates]
-            )
+            # verify only what the speculative pass (if any) missed —
+            # with verify_ahead on and an idle verifier this list is
+            # empty and delivery never blocks on signature checks
+            unchecked = [
+                (k, p) for k, p in quorate if k not in session.prechecked
+            ]
+            if unchecked:
+                fresh = await self.verifier.verify_many(
+                    [(p.sender, p.to_sign(), p.signature)
+                     for _, p in unchecked]
+                )
+                for (k, _), ok in zip(unchecked, fresh):
+                    session.prechecked[k] = ok
+            candidates = [p for _, p in quorate]
+            results = [session.prechecked[k] for k, _ in quorate]
             now = self.clock.monotonic()
             frontier = self.accounts.frontier_nowait()
             applied = 0
@@ -1861,6 +1887,35 @@ class Service(At2Servicer):
             return responses, applied
         finally:
             self._catchup_session = None
+
+    async def _verify_ahead_wait(
+        self, session: _CatchupSession, window: float
+    ) -> None:
+        """[wan] speculative verify-ahead: slice the quorum wait and
+        spend idle verifier capacity pre-verifying parked payloads as
+        they stream in, caching verdicts in ``session.prechecked`` so
+        the post-quorum apply step never blocks on signature checks.
+        Occupancy-gated: a busy verifier (nonzero queue depth — the
+        device pool reports one; CpuVerifier has no queue and always
+        reads idle) keeps its capacity for live traffic."""
+        slices = 4
+        for _ in range(slices):
+            await self.clock.sleep(window / slices)
+            stats_fn = getattr(self.verifier, "stats", None)
+            if stats_fn is not None and stats_fn().get("queue_depth", 0):
+                continue
+            pending = [
+                (k, p) for k, p in list(session.payloads.items())
+                if k not in session.prechecked
+            ]
+            if not pending:
+                continue
+            verdicts = await self.verifier.verify_many(
+                [(p.sender, p.to_sign(), p.signature) for _, p in pending]
+            )
+            for (k, _), ok in zip(pending, verdicts):
+                session.prechecked[k] = ok
+            self.catchup_stats["catchup_preverified"] += len(pending)
 
     # -- ingress batching (broadcast/stack.py batched plane) --------------
 

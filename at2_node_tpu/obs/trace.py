@@ -60,7 +60,9 @@ from collections import deque
 
 from .registry import Histogram, Registry
 
-__all__ = ["BROKER_STAGES", "REJECTED", "STAGES", "TxTrace"]
+__all__ = [
+    "BROKER_STAGES", "PHASE_MARKERS", "REJECTED", "STAGES", "TxTrace",
+]
 
 STAGES: tuple[str, ...] = (
     "ingress",
@@ -86,6 +88,19 @@ _STAGE_IDX = {s: i for i, s in enumerate(STAGES)}
 BROKER_STAGES: tuple[str, ...] = ("broker_rx", "broker_flush")
 _STAGE_IDX["broker_rx"] = -2
 _STAGE_IDX["broker_flush"] = -1
+
+# Order-free phase markers (ISSUE 14 phase-overlap accounting). With
+# [wan] overlap_ready on, a node emits its Ready in the same frame as
+# its Echo — so "the echo quorum was observed" and "own Ready was sent"
+# can land in EITHER order, which the ``idx <= rec[_IDX]`` ladder guard
+# would silently truncate to whichever arrived first. Markers are
+# therefore stamped OUTSIDE the ladder: appended once to the record's
+# stamp list (first arrival wins per marker), never advancing the
+# ladder index, never feeding a histogram, never opening a relay span.
+# trace_collect.py reads them back as the per-slot echo→ready gap
+# (negative = piggybacked). Deliberately NOT in ``STAGES`` for the same
+# reason BROKER_STAGES is not.
+PHASE_MARKERS: frozenset = frozenset({"echo_quorum", "ready_sent"})
 
 # Out-of-ladder terminal: admission control refused the transaction at
 # the RPC boundary (token-bucket throttle or failed pre-verification).
@@ -196,6 +211,16 @@ class TxTrace:
 
     def stamp(self, key: tuple, stage: str, now: float | None = None) -> None:
         rec = self._live.get(key)
+        if stage in PHASE_MARKERS:
+            # order-free annotation on an already-open record: no ladder
+            # index, no histogram, no relay-span open
+            if rec is None:
+                return
+            if any(s == stage for s, _, _ in rec[_STAMPS]):
+                return  # first arrival wins
+            t = self._clock.monotonic() if now is None else now
+            rec[_STAMPS].append((stage, t, self._clock.wall()))
+            return
         terminal = stage == "committed" or stage == REJECTED
         if rec is None:
             # Relay-side open: a stamp for a key this node never saw at

@@ -189,9 +189,16 @@ class SimMesh:
         own_sign: bytes,
         peers: Iterable[Peer],
         on_frame,
+        region_fanout: bool = False,
     ) -> None:
         self.fabric = fabric
         self.own_sign = own_sign
+        # [wan] region-aware fanout: broadcast walks peers nearest-first
+        # by configured link latency. The sim twin of the real mesh's
+        # RTT-EWMA ordering — here latency is declared, so the order is
+        # a pure function of topology (deterministic, but it DOES change
+        # the fabric-rng draw order vs the off path, hence knob-gated).
+        self.region_fanout = region_fanout
         self.peers: List[Peer] = list(peers)
         self.by_exchange: Dict[bytes, Peer] = {
             p.exchange_public: p for p in self.peers
@@ -211,6 +218,7 @@ class SimMesh:
             "send_queue_depth": self.fabric.in_flight,
             "redials": 0,
             "dial_failures": 0,
+            "peer_reconnects": 0,
             "send_overflows": self.send_overflows,
             "native_readers": 0,
             "reader_drops": 0,
@@ -228,7 +236,17 @@ class SimMesh:
 
     def broadcast(self, frame: bytes, exclude: Iterable[bytes] = ()) -> None:
         skip = set(exclude)
-        for peer in self.peers:
+        peers = self.peers
+        if self.region_fanout:
+            # stable sort: equal-latency (same-region) peers keep their
+            # configured order, so the schedule stays deterministic
+            peers = sorted(
+                peers,
+                key=lambda p: self.fabric.link(
+                    self.own_sign, p.sign_public
+                ).latency,
+            )
+        for peer in peers:
             if peer.exchange_public not in skip:
                 self.send(peer, frame)
 

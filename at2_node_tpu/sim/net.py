@@ -238,7 +238,8 @@ class SimNet:
         catchup nonces salted with the node's incarnation count."""
         cfg = self.configs[i]
         mesh_factory = lambda c, on_frame: SimMesh(  # noqa: E731
-            self.fabric, c.sign_key.public, c.nodes, on_frame
+            self.fabric, c.sign_key.public, c.nodes, on_frame,
+            region_fanout=c.wan.region_fanout,
         )
         service = await Service.start(
             cfg,

@@ -8,7 +8,7 @@ SendDistilledBatch frames on a size/deadline trigger.
 Usage:
     python -m at2_node_tpu.tools.broker \
         --node http://127.0.0.1:4001 --listen 0.0.0.0:5001 \
-        [--max-entries 1024] [--window 0.005]
+        [--max-entries 1024] [--window 0.005] [--eager]
 """
 
 from __future__ import annotations
@@ -28,6 +28,7 @@ async def _run(args) -> int:
         args.listen,
         max_entries=args.max_entries,
         window=args.window,
+        eager=args.eager,
     )
     try:
         await broker.serve_forever()
@@ -49,6 +50,10 @@ def main(argv=None) -> int:
                     f"(cap {DISTILL_MAX_ENTRIES})")
     ap.add_argument("--window", type=float, default=0.005,
                     help="flush deadline in seconds for a partial buffer")
+    ap.add_argument("--eager", action="store_true",
+                    help="anchor the flush deadline to the first buffered "
+                    "entry and shrink it as the buffer fills (lower "
+                    "tail latency, smaller frames)")
     ap.add_argument("--log-level", default="warning")
     args = ap.parse_args(argv)
     logging.basicConfig(level=args.log_level.upper())

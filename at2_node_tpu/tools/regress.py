@@ -341,18 +341,32 @@ def load_durability(name: str, doc: dict) -> List[dict]:
 
 
 def load_scenarios(name: str, doc: dict) -> List[dict]:
+    """BENCH_SCENARIOS.json: the scenario grid. Series are named by the
+    cell's coordinates (topology.workload.faults, "+wan" for the
+    [wan]-knobs-on variants), not by index, so a series keeps meaning
+    the same cell as the grid grows. p99 is required; p50/p90 bank when
+    present (captures from before the histogram extension lack them).
+    The comparability key carries the wan flag — an overlap-on capture
+    never judges against an overlap-off one."""
     cells = _require(doc, "cells", name, list)
     _require(doc, "grid_hash", name, str)
     rows: List[dict] = []
     for i, cell in enumerate(cells):
         path = f"{name}.cells[{i}]"
+        cname = (
+            f"{_require(cell, 'topology', path, str)}"
+            f".{_require(cell, 'workload', path, str)}"
+            f".{_require(cell, 'faults', path, str)}"
+        )
+        if cell.get("wan"):
+            cname += "+wan"
         comp = (
             f"nodes={cell.get('nodes')} faults={cell.get('faults')} "
-            f"offered={cell.get('offered')}"
+            f"offered={cell.get('offered')} wan={bool(cell.get('wan'))}"
         )
         rows.append(
             _row(
-                f"scenarios/cell{i}.latency_p99_ms",
+                f"scenarios/{cname}.latency_p99_ms",
                 "current",
                 0,
                 _num(cell, "latency_p99_ms", path),
@@ -360,6 +374,18 @@ def load_scenarios(name: str, doc: dict) -> List[dict]:
                 lower_better=True,
             )
         )
+        for quantile in ("latency_p50_ms", "latency_p90_ms"):
+            if quantile in cell:
+                rows.append(
+                    _row(
+                        f"scenarios/{cname}.{quantile}",
+                        "current",
+                        0,
+                        _num(cell, quantile, path),
+                        comp,
+                        lower_better=True,
+                    )
+                )
     return rows
 
 

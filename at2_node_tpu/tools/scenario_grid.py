@@ -47,8 +47,9 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="2x2 CI slice: LAN/WAN x steady/flash-crowd, "
                         "no faults")
-    parser.add_argument("--replay", metavar="TOPO/WORKLOAD/FAULTS",
-                        help="re-run exactly one cell and print it")
+    parser.add_argument("--replay", metavar="TOPO/WORKLOAD/FAULTS[+wan]",
+                        help="re-run exactly one cell and print it "
+                        "(+wan replays the [wan]-knobs-on variant)")
     parser.add_argument("--nodes", type=int, default=4,
                         help="correct nodes per cell (default 4)")
     parser.add_argument("--faults", type=int, default=1,
@@ -80,14 +81,22 @@ def main(argv=None) -> int:
     )
 
     if args.replay:
+        # a trailing "+wan" replays the WAN_GRID variant of the cell:
+        # [wan] knobs on, and "wan" folded into the seed derivation the
+        # same way run_grid does it
+        spec, _, variant = args.replay.partition("+")
+        wan = variant == "wan"
+        if variant and not wan:
+            parser.error(f"unknown cell variant {variant!r}")
         try:
-            topology, workload, faults = args.replay.split("/")
+            topology, workload, faults = spec.split("/")
         except ValueError:
-            parser.error("--replay wants TOPOLOGY/WORKLOAD/FAULTS")
-        cell_seed = _seed_int(
-            "grid", args.seed, topology, workload, faults
-        ) % (1 << 32)
-        cell = run_cell(cell_seed, topology, workload, faults, **kw)
+            parser.error("--replay wants TOPOLOGY/WORKLOAD/FAULTS[+wan]")
+        seed_parts = ("grid", args.seed, topology, workload, faults) + (
+            ("wan",) if wan else ()
+        )
+        cell_seed = _seed_int(*seed_parts) % (1 << 32)
+        cell = run_cell(cell_seed, topology, workload, faults, wan=wan, **kw)
         if args.json:
             print(json.dumps(cell, sort_keys=True, indent=1))
         else:
@@ -109,9 +118,10 @@ def main(argv=None) -> int:
             verdict = f"VIOLATED: {cell['violations'][0]}"
         elif not cell["slo"]["ok"]:
             verdict = "SLO BREACH: " + ",".join(cell["slo"]["breaching"])
+        tag = "+wan" if cell.get("wan") else ""
         print(
             f"{cell['topology']:>5}/{cell['workload']:<12}"
-            f"faults={cell['faults']:<5} "
+            f"faults={cell['faults'] + tag:<9} "
             f"committed {cell['committed']:3d}/{cell['offered']:3d}  "
             f"tput {cell['throughput_tps']:6.2f}tps  "
             f"p99 {cell['latency_p99_ms']:8.1f}ms  "

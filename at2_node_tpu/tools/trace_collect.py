@@ -33,7 +33,7 @@ Three consumers:
 Usage:
     python -m at2_node_tpu.tools.trace_collect HOST:PORT [HOST:PORT ...]
         [--limit N] [--chrome trace.json] [--stitched stitched.json]
-        [--json]
+        [--json] [--overlap]
 """
 
 from __future__ import annotations
@@ -48,7 +48,10 @@ from .top import fetch_json
 
 # ladder order for sorting stages within a (tx, node) span; the broker
 # hop precedes node ingress on the distilled path; rejected sits past
-# committed (both are terminal, a record holds at most one)
+# committed (both are terminal, a record holds at most one).
+# echo_quorum / ready_sent are the [wan] overlap markers: they sort at
+# their SEMANTIC position (quorum observed, ready emitted) even though
+# overlap_ready makes ready_sent fire temporally first.
 _STAGE_ORDER = {
     s: i
     for i, s in enumerate(
@@ -58,6 +61,8 @@ _STAGE_ORDER = {
             "ingress",
             "admitted",
             "echoed",
+            "echo_quorum",
+            "ready_sent",
             "ready_quorum",
             "delivered",
             "committed",
@@ -275,6 +280,63 @@ def stitch(dumps: list) -> dict:
     }
 
 
+def phase_overlap(stitched: dict) -> dict:
+    """Per-(tx, node) echo→ready phase gap from the overlap markers:
+    ``gap_ms = ready_sent − echo_quorum``. Positive means Ready waited
+    on the echo quorum (the serial two-round schedule), zero means both
+    fired in the same advance, and NEGATIVE means Ready rode the same
+    frame as the Echo — the [wan] overlap_ready piggyback that removes
+    one long-haul round from the commit path. Spans missing either
+    marker (captures predating the markers, relay records that never
+    reached quorum) are skipped but counted."""
+    rows = []
+    skipped = 0
+    for tx in stitched["txs"]:
+        for span in tx["spans"]:
+            marks = {s: rel for s, rel in span["stages"]}
+            if "echo_quorum" not in marks or "ready_sent" not in marks:
+                skipped += 1
+                continue
+            rows.append(
+                {
+                    "sender": tx["sender"],
+                    "seq": tx["seq"],
+                    "node": span["node"],
+                    "gap_ms": round(
+                        (marks["ready_sent"] - marks["echo_quorum"]) * 1e3,
+                        6,
+                    ),
+                }
+            )
+    gaps = sorted(r["gap_ms"] for r in rows)
+    return {
+        "spans": len(rows),
+        "skipped_spans": skipped,
+        "piggybacked": sum(1 for g in gaps if g < 0.0),
+        "gap_p50_ms": round(_pctl(gaps, 0.50), 6),
+        "gap_p99_ms": round(_pctl(gaps, 0.99), 6),
+        "gap_min_ms": round(gaps[0], 6) if gaps else 0.0,
+        "gap_max_ms": round(gaps[-1], 6) if gaps else 0.0,
+        "rows": rows,
+    }
+
+
+def render_overlap(report: dict) -> str:
+    """Operator text for :func:`phase_overlap`."""
+    return "\n".join(
+        [
+            f"phase overlap: {report['spans']} spans with both markers "
+            f"({report['skipped_spans']} without), "
+            f"{report['piggybacked']} piggybacked (gap < 0)",
+            "  echo_quorum→ready_sent gap ms: "
+            f"p50 {report['gap_p50_ms']:.3f}  "
+            f"p99 {report['gap_p99_ms']:.3f}  "
+            f"min {report['gap_min_ms']:.3f}  "
+            f"max {report['gap_max_ms']:.3f}",
+        ]
+    )
+
+
 def render_summary(stitched: dict) -> str:
     """Operator text: coverage, per-stage cross-node percentiles,
     straggler attribution."""
@@ -429,6 +491,9 @@ def main(argv=None) -> int:
                     help="write the full stitched JSON")
     ap.add_argument("--json", action="store_true",
                     help="print stitched JSON instead of the summary")
+    ap.add_argument("--overlap", action="store_true",
+                    help="append the echo→ready phase-overlap report "
+                    "(negative gap = Ready piggybacked on the Echo)")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
     addrs = [_parse_addr(a) for a in args.nodes]
@@ -449,9 +514,14 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
     if args.json:
+        if args.overlap:
+            stitched = dict(stitched, phase_overlap=phase_overlap(stitched))
         print(json.dumps(stitched, sort_keys=True, indent=1))
     else:
         print(render_summary(stitched))
+        if args.overlap:
+            print()
+            print(render_overlap(phase_overlap(stitched)))
     return 0
 
 

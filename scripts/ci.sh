@@ -205,6 +205,43 @@ if [ -z "$g1" ] || [ "$g1" != "$g2" ]; then
 fi
 echo "same-seed scenario grid hash reproduced: $g1"
 
+echo "== wan-finality gate =="
+# Sub-second WAN finality (ISSUE 14): replay the [wan]-knobs-on wan3
+# steady and cut cells twice each. Each cell must reproduce its trace
+# hash byte-identically (the overlap levers are deterministic), and the
+# steady cell must clear the sub-second SLO bar: commit p99 < 1000 ms
+# with every offered transfer committed.
+wan_cell() {  # $1 = FAULTS
+  python -m at2_node_tpu.tools.scenario_grid --seed 7 \
+    --replay "wan3/steady/$1+wan" --txs 24 --duration 8 --json
+}
+wan_steady_json=""
+for wfaults in none cut; do
+  wj1="$(wan_cell "$wfaults")"
+  wj2="$(wan_cell "$wfaults")"
+  wh1="$(printf '%s' "$wj1" | python -c 'import json,sys; print(json.load(sys.stdin)["trace_hash"])')"
+  wh2="$(printf '%s' "$wj2" | python -c 'import json,sys; print(json.load(sys.stdin)["trace_hash"])')"
+  if [ -z "$wh1" ] || [ "$wh1" != "$wh2" ]; then
+    echo "wan-finality gate FAILED: wan3/steady/$wfaults+wan hash '$wh1' != '$wh2'" >&2
+    exit 1
+  fi
+  echo "wan3/steady/$wfaults+wan hash reproduced: $wh1"
+  [ "$wfaults" = none ] && wan_steady_json="$wj1"
+done
+# the cell JSON rides an env var: the heredoc IS python's stdin here,
+# so piping the JSON in as well would race the program text
+WAN_STEADY_CELL="$wan_steady_json" python - <<'EOF'
+import json, os
+cell = json.loads(os.environ["WAN_STEADY_CELL"])
+p99 = cell["latency_p99_ms"]
+assert cell["committed"] == cell["offered"], (
+    f"wan steady cell lost transfers: {cell['committed']}/{cell['offered']}")
+assert not cell["violations"], cell["violations"]
+assert cell["slo"]["ok"], f"SLO breach: {cell['slo']['breaching']}"
+assert p99 < 1000.0, f"sub-second WAN finality missed: p99 {p99} ms"
+print(f"wan3 steady +wan: p99 {p99} ms < 1000 ms, SLO ok")
+EOF
+
 echo "== observability overhead gate =="
 # The full tracer + recorder + SLO probe cost, measured as plane
 # throughput with observability on vs off (interleaved arms, best-of-N
