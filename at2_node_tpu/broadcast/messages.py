@@ -65,6 +65,16 @@ DIR_ANNOUNCE = 13  # (announcing node, [(client_id, pubkey)...])
 # node converges on the new epoch; messages from epochs older than the
 # grace window are rejected (stack.py / membership.py).
 CONFIG_TX = 14  # (epoch, admin signature, JSON change description)
+# Fleet-consistency audit plane (obs/audit.py): each node periodically
+# gossips a signed digest of its committed ledger state — additive
+# (commutative) lanes over the account ranges, the per-sender commit
+# watermarks, and the client directory, plus a local hash-chain head.
+# Peers compare beacons taken at the *same watermark digest* (equal
+# watermark vector ⇔ equal committed set under AT2's gap-free per-sender
+# sequencing), so nodes that legitimately commit in different orders
+# never false-positive, while a real ledger divergence conflicts at an
+# identical coordinate and flips /healthz to `diverged` with attribution.
+BEACON = 15  # (epoch, commits, wm/account/directory digests, chain head)
 
 _PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
 _ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
@@ -79,6 +89,9 @@ _BATCH_REQ = struct.Struct("<32sQ32s")  # batch origin, batch_seq, hash
 _DIR_HDR = struct.Struct("<32sI")  # announcing node, entry count
 _DIR_ENTRY = struct.Struct("<Q32s")  # client id, client pubkey
 _CONFIG_HDR = struct.Struct("<QI64s")  # epoch, body length, admin sig
+# origin, epoch, commits, wm digest (16B), 16 u64 account-range lanes
+# (128B), directory digest (8B), local chain head (32B); + 64B signature
+_BEACON_BODY = struct.Struct("<32sQQ16s128s8s32s")
 
 PAYLOAD_WIRE = 1 + _PAYLOAD.size
 ATTEST_WIRE = 1 + _ATTEST.size
@@ -92,6 +105,7 @@ BATCH_ATT_WIRE = 1 + _BATCH_ATT.size + 64  # variable: + bitmap before sig
 BATCH_REQ_WIRE = 1 + _BATCH_REQ.size
 DIR_HDR_WIRE = 1 + _DIR_HDR.size  # variable: header + count entries
 CONFIG_HDR_WIRE = 1 + _CONFIG_HDR.size  # variable: header + JSON body
+BEACON_WIRE = 1 + _BEACON_BODY.size + 64  # fixed: body + origin signature
 
 # Bounds one announce's parse amplification (a full directory re-sync
 # splits across several announces).
@@ -120,6 +134,7 @@ _BATCH_TAG = b"at2-node-tpu/batch/v1"
 _BECHO_TAG = b"at2-node-tpu/batch-echo/v1"
 _BREADY_TAG = b"at2-node-tpu/batch-ready/v1"
 _CONFIG_TAG = b"at2-node-tpu/config-tx/v1"
+_BEACON_TAG = b"at2-node-tpu/beacon/v1"
 
 
 class WireError(Exception):
@@ -636,6 +651,113 @@ class ConfigTx:
         return ConfigTx(epoch, payload, sig)
 
 
+@dataclass(frozen=True)
+class StateBeacon:
+    """A signed fleet-audit digest of one node's committed ledger state
+    (obs/audit.py builds, compares, and attributes; TECHNICAL.md "Fleet
+    audit & incident capture" documents the digest rules).
+
+    All cross-node-comparable fields are *additive* digests — unordered
+    sums over the state, so two correct nodes that committed the same
+    set of transactions in different orders produce identical values:
+
+    * ``wm_digest``  — 128-bit sum of H(sender, last_sequence) over the
+      commit-watermark frontier; the comparison coordinate.
+    * ``ranges``     — sixteen u64 lanes, one per account range
+      (``key[0] >> 4``), each a sum of H(key, balance, sequence) over
+      the accounts in that range; lane-granular attribution.
+    * ``dir_digest`` — u64 sum of H(client_id, pubkey) over the client
+      directory (informational: directory gossip is eventually
+      consistent, so skew here is never treated as divergence).
+
+    ``chain`` is the node's *local* sha256 digest-chain head — folded
+    per beacon point and persisted in the store manifest as restart
+    tamper evidence; it is order-dependent and never compared across
+    peers. The origin signature makes a beacon non-repudiable evidence
+    in incident bundles."""
+
+    origin: bytes  # beaconing node's sign key
+    epoch: int  # membership epoch the digest was taken under
+    commits: int  # node-local committed-transfer count at the snapshot
+    wm_digest: bytes  # 16B additive watermark digest (the coordinate)
+    ranges: bytes  # 16 little-endian u64 account-range lanes (128B)
+    dir_digest: bytes  # 8B additive client-directory digest
+    chain: bytes  # 32B local digest-chain head (never compared)
+    signature: bytes  # origin ed25519 over signing_bytes()
+
+    @staticmethod
+    def signing_bytes(
+        origin: bytes,
+        epoch: int,
+        commits: int,
+        wm_digest: bytes,
+        ranges: bytes,
+        dir_digest: bytes,
+        chain: bytes,
+    ) -> bytes:
+        return _BEACON_TAG + _BEACON_BODY.pack(
+            origin, epoch, commits, wm_digest, ranges, dir_digest, chain
+        )
+
+    def to_sign(self) -> bytes:
+        return self.signing_bytes(
+            self.origin,
+            self.epoch,
+            self.commits,
+            self.wm_digest,
+            self.ranges,
+            self.dir_digest,
+            self.chain,
+        )
+
+    @classmethod
+    def create(
+        cls,
+        keypair,
+        epoch: int,
+        commits: int,
+        wm_digest: bytes,
+        ranges: bytes,
+        dir_digest: bytes,
+        chain: bytes,
+    ) -> "StateBeacon":
+        sig = keypair.sign(
+            cls.signing_bytes(
+                keypair.public, epoch, commits, wm_digest, ranges,
+                dir_digest, chain,
+            )
+        )
+        return cls(
+            keypair.public, epoch, commits, wm_digest, ranges, dir_digest,
+            chain, sig,
+        )
+
+    def encode(self) -> bytes:
+        return (
+            bytes([BEACON])
+            + _BEACON_BODY.pack(
+                self.origin,
+                self.epoch,
+                self.commits,
+                self.wm_digest,
+                self.ranges,
+                self.dir_digest,
+                self.chain,
+            )
+            + self.signature
+        )
+
+    @staticmethod
+    def decode_body(body: bytes) -> "StateBeacon":
+        origin, epoch, commits, wm, ranges, dird, chain = _BEACON_BODY.unpack(
+            body[: _BEACON_BODY.size]
+        )
+        return StateBeacon(
+            origin, epoch, commits, wm, ranges, dird, chain,
+            body[_BEACON_BODY.size :],
+        )
+
+
 def parse_frame(frame: bytes) -> list:
     """Split a frame into messages (frames may coalesce many)."""
     out = []
@@ -743,6 +865,11 @@ def parse_frame(frame: bytes) -> list:
                 raise WireError("truncated config tx body")
             out.append(ConfigTx.decode_body(bytes(view[1:total])))
             view = view[total:]
+        elif kind == BEACON:
+            if len(view) < BEACON_WIRE:
+                raise WireError("truncated state beacon")
+            out.append(StateBeacon.decode_body(bytes(view[1:BEACON_WIRE])))
+            view = view[BEACON_WIRE:]
         else:
             raise WireError(f"unknown message kind {kind}")
     return out

@@ -183,6 +183,7 @@ class ShardedPlane:
         self.catchup_handler = None
         self.directory_handler = None
         self.config_handler = None
+        self.beacon_handler = None
         self.stall_handler = None
 
         self.stats = self.registry.counter_group((
@@ -603,6 +604,11 @@ class ShardedPlane:
     # core's GC pass via stall, so keep them all consistent)
     def __setattr__(self, name, value):
         object.__setattr__(self, name, value)
-        if name in ("catchup_handler", "directory_handler", "config_handler"):
+        if name in (
+            "catchup_handler",
+            "directory_handler",
+            "config_handler",
+            "beacon_handler",
+        ):
             for core in getattr(self, "_cores", ()):  # pre-init writes
                 setattr(core, name, value)

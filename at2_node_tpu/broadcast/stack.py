@@ -111,6 +111,7 @@ from .messages import (
     HistoryIndexRequest,
     HistoryRequest,
     Payload,
+    StateBeacon,
     TxBatch,
     WireError,
     parse_frame,
@@ -219,13 +220,18 @@ class _BoundedSet:
 
 class _BoundedDict:
     """Insertion-ordered dict with FIFO eviction at a fixed capacity
-    (the mapping twin of :class:`_BoundedSet`)."""
+    (the mapping twin of :class:`_BoundedSet`). ``evictions`` counts
+    entries shed at the cap — nonzero on the entry registry means the
+    sizing argument at its construction site was violated in practice
+    (surfaced as the ``entry_evictions`` gauge; the fleet-audit beacons
+    are the cross-node backstop for any divergence this could cause)."""
 
-    __slots__ = ("_cap", "_items")
+    __slots__ = ("_cap", "_items", "evictions")
 
     def __init__(self, cap: int) -> None:
         self._cap = cap
         self._items: Dict = {}
+        self.evictions = 0
 
     def get(self, key, default=None):
         return self._items.get(key, default)
@@ -234,6 +240,7 @@ class _BoundedDict:
         if key not in self._items:
             if len(self._items) >= self._cap:
                 self._items.pop(next(iter(self._items)))
+                self.evictions += 1
         self._items[key] = value
 
     def pop(self, key, default=None):
@@ -520,6 +527,10 @@ class Broadcast:
         # callable (peer, msg) -> None; node/membership.py) — same shape
         # as directory_handler; None drops them
         self.config_handler = None
+        # node-service hook for fleet-audit state beacons (sync callable
+        # (peer, msg) -> None; obs/audit.py) — same shape as
+        # directory_handler; None drops them
+        self.beacon_handler = None
         # sim hook fired whenever this node SIGNS an attestation (either
         # plane): callable (phase, origin_or_sender, sequence, chash).
         # The simulator's no-post-restart-equivocation invariant records
@@ -585,6 +596,12 @@ class Broadcast:
         self.registry.gauge(
             "inbox_depth", "raw frames queued for the broadcast workers",
             fn=lambda: self._inbox.qsize(),
+        )
+        self.registry.gauge(
+            "entry_evictions",
+            "entry-registry bindings shed at the FIFO cap (should be 0; "
+            "see the sizing argument at the registry's construction)",
+            fn=lambda: self._entry_registry.evictions,
         )
         self.stats = self.registry.counter_group((
             "gossip_rx",
@@ -1084,6 +1101,15 @@ class Broadcast:
                     self.config_handler(peer, msg)
                 except Exception:
                     logger.exception("config handler error")
+        elif isinstance(msg, StateBeacon):
+            # fleet-audit digests (obs/audit.py); the handler verifies
+            # the origin signature — beacon rates are a few per second
+            # per peer, so the sync verify never matters for the plane
+            if self.beacon_handler is not None:
+                try:
+                    self.beacon_handler(peer, msg)
+                except Exception:
+                    logger.exception("beacon handler error")
         else:
             if self._pre_attestation(msg, peer):
                 to_verify.append((msg.origin, msg.to_sign(), msg.signature))

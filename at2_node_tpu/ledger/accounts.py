@@ -27,6 +27,7 @@ import asyncio
 import logging
 from typing import Dict
 
+from ..obs.audit import LedgerDigest
 from .account import Account, AccountException
 
 logger = logging.getLogger(__name__)
@@ -47,6 +48,11 @@ class Accounts:
     def __init__(self) -> None:
         self._ledger: Dict[bytes, Account] = {}
         self._lock = asyncio.Lock()
+        # Fleet-audit digest lanes (obs/audit.py): folded at every
+        # mutation site below so they are always an O(1)-maintained pure
+        # function of the current ledger state — the beacon plane reads
+        # them without ever scanning the ledger.
+        self.digest = LedgerDigest()
 
     def close(self) -> None:
         """Kept for API symmetry with heavier backends; nothing to stop."""
@@ -66,6 +72,10 @@ class Accounts:
                 bytes.fromhex(user): Account(last_sequence=seq, balance=bal)
                 for user, (seq, bal) in data.items()
             }
+            self.digest.reseed(
+                (user, a.last_sequence, a.balance)
+                for user, a in self._ledger.items()
+            )
 
     def frontier_nowait(self) -> Dict[bytes, int]:
         """Point-in-time {sender: last_sequence} map, lock-free.
@@ -117,20 +127,46 @@ class Accounts:
         async with self._lock:
             return fn(self)
 
+    def _touch(self, key: bytes, old: tuple, account: Account) -> None:
+        """Fold one row's (sequence, balance) change into the audit
+        digest; no-op when the observable state did not change."""
+        if old != (account.last_sequence, account.balance):
+            self.digest.touch(
+                key, old[0], old[1], account.last_sequence, account.balance
+            )
+
+    def _tamper(self, user: bytes, delta: int) -> None:
+        """Failpoint back door (sim/campaign.py planted-divergence
+        episodes): misapply ``delta`` to ``user``'s balance exactly as a
+        buggy apply would. The digest folds the corrupted post-state —
+        which is precisely what lets peers' auditors catch it."""
+        account = self._ledger.setdefault(user, Account())
+        old = (account.last_sequence, account.balance)
+        account.balance += delta
+        self._touch(user, old, account)
+
     def _transfer(
         self, sender: bytes, sender_sequence: int, receiver: bytes, amount: int
     ) -> None:
         if sender == receiver:
             logger.warning("transfer to itself: %s", sender.hex())
             account = self._ledger.setdefault(sender, Account())
+            old = (account.last_sequence, account.balance)
             try:
                 account.debit(sender_sequence, 0)
             except AccountException as exc:
+                self._touch(sender, old, account)
                 raise AccountModificationError(exc) from exc
+            self._touch(sender, old, account)
             return
 
         sender_account = self._ledger.get(sender) or Account()
         receiver_account = self._ledger.get(receiver) or Account()
+        sender_old = (sender_account.last_sequence, sender_account.balance)
+        receiver_old = (
+            receiver_account.last_sequence,
+            receiver_account.balance,
+        )
 
         try:
             sender_account.debit(sender_sequence, amount)
@@ -138,11 +174,14 @@ class Accounts:
             # Persist the (sequence-consumed) sender state even on failure
             # (accounts/mod.rs:190-194).
             self._ledger[sender] = sender_account
+            self._touch(sender, sender_old, sender_account)
             raise AccountModificationError(exc) from exc
         self._ledger[sender] = sender_account
+        self._touch(sender, sender_old, sender_account)
 
         try:
             receiver_account.credit(amount)
         except AccountException as exc:
             raise AccountModificationError(exc) from exc
         self._ledger[receiver] = receiver_account
+        self._touch(receiver, receiver_old, receiver_account)

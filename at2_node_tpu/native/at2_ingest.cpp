@@ -158,7 +158,7 @@ void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
 constexpr uint8_t kGossip = 1, kEcho = 2, kReady = 3, kRequest = 4;
 constexpr uint8_t kHistIdxReq = 5, kHistIdx = 6, kHistReq = 7, kHistBatch = 8;
 constexpr uint8_t kBatch = 9, kBatchEcho = 10, kBatchReady = 11, kBatchReq = 12;
-constexpr uint8_t kDirAnnounce = 13, kConfigTx = 14;
+constexpr uint8_t kDirAnnounce = 13, kConfigTx = 14, kBeacon = 15;
 constexpr size_t kPayloadWire = 1 + 140;
 constexpr size_t kAttestWire = 1 + 164;
 constexpr size_t kRequestWire = 1 + 68;
@@ -187,6 +187,9 @@ constexpr uint64_t kMaxDirEntries = 4096;  // messages.MAX_DIR_ENTRIES
 // CONFIG_TX = 0x0e | epoch(u64) len(u32) sig(64) len*JSON bytes
 constexpr size_t kConfigHdrWire = 1 + 76;
 constexpr uint64_t kMaxConfigBytes = 4096;  // messages.MAX_CONFIG_BYTES
+// BEACON = 0x0f | origin(32) epoch(u64) commits(u64) wm(16) ranges(128)
+//                 dir(8) chain(32) sig(64) — fixed, messages.BEACON_WIRE
+constexpr size_t kBeaconWire = 1 + 232 + 64;
 constexpr size_t kMinWire = kHistIdxReqWire;  // smallest message on the wire
 // A legitimate frame coalesces at most MAX_BATCH_MSGS = 1024 messages
 // (net/peers.py); 4x that is the malformed-frame bound. Without it a
@@ -303,6 +306,8 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
         uint64_t body_len = le32(p + 1 + 8);  // after epoch(u64)
         if (body_len > kMaxConfigBytes) { ok = false; break; }
         wire = kConfigHdrWire + size_t(body_len);
+      } else if (kind == kBeacon) {
+        wire = kBeaconWire;  // fixed but wider than kRowStride
       } else { ok = false; break; }
       if (left < wire) { ok = false; break; }
       if (n_out - start >= kMaxMsgsPerFrame) { ok = false; break; }
@@ -311,8 +316,10 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
       row[0] = kind;
       if (kind == kHistIdx || kind == kHistBatch || kind == kBatch ||
           kind == kBatchEcho || kind == kBatchReady || kind == kDirAnnounce ||
-          kind == kConfigTx) {
-        // variable-length kinds: row carries (offset, length) into `flat`
+          kind == kConfigTx || kind == kBeacon) {
+        // variable-length kinds (and the beacon, whose fixed 296-byte
+        // body is wider than kRowStride): row carries (offset, length)
+        // into `flat`
         put_le64(row + 1, uint64_t(p + 1 - flat));
         put_le64(row + 9, uint64_t(wire - 1));
       } else {

@@ -29,6 +29,7 @@ from ..broadcast.messages import (
     BATCH_ECHO,
     BATCH_READY,
     BATCH_REQ,
+    BEACON,
     CONFIG_TX,
     DIR_ANNOUNCE,
     ECHO,
@@ -53,6 +54,7 @@ from ..broadcast.messages import (
     HistoryIndexRequest,
     HistoryRequest,
     Payload,
+    StateBeacon,
     TxBatch,
 )
 from ._build import U8P, U32P, U64P, load_lib, pack_ragged, ptr8
@@ -230,9 +232,10 @@ def parse_frames_native(frames: Sequence[bytes]):
             msg = BatchContentRequest.decode_body(row_bytes[base + 1 : base + 73])
         elif kind in (
             HIST_IDX, HIST_BATCH, BATCH, BATCH_ECHO, BATCH_READY,
-            DIR_ANNOUNCE, CONFIG_TX,
+            DIR_ANNOUNCE, CONFIG_TX, BEACON,
         ):
             # variable-length rows carry (offset, length) into `flat`
+            # (BEACON is fixed-size but wider than the row stride)
             off = int.from_bytes(row_bytes[base + 1 : base + 9], "little")
             ln = int.from_bytes(row_bytes[base + 9 : base + 17], "little")
             body = flat[off : off + ln].tobytes()
@@ -242,6 +245,8 @@ def parse_frames_native(frames: Sequence[bytes]):
                 msg = BatchAttestation.decode_body(kind, body)
             elif kind == CONFIG_TX:
                 msg = ConfigTx.decode_body(body)
+            elif kind == BEACON:
+                msg = StateBeacon.decode_body(body)
             elif kind == DIR_ANNOUNCE:
                 origin, _count = _DIR_HDR.unpack_from(body)
                 msg = DirectoryAnnounce.decode_body(origin, body[_DIR_HDR.size :])

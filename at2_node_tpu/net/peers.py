@@ -36,6 +36,7 @@ import asyncio
 import logging
 import os
 import socket as socket_mod
+from collections import deque
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Dict, Iterable, List, Optional
 
@@ -84,6 +85,7 @@ class Mesh:
         clock=None,
         region_fanout: bool = False,
         region: str = "",
+        capture_cap: int = 0,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
 
@@ -123,6 +125,15 @@ class Mesh:
         self.peer_reconnects = 0  # successful re-dials AFTER a drop
         self.send_overflows = 0
         self._reader_drops_closed = 0  # drops of already-closed readers
+        # Inbound wire-capture ring (obs/audit.py plane, served on
+        # /capturez, replayed by tools/capture_replay.py): a bounded
+        # deque of (mono_ns, peer sign hex, first kind byte, frame hex)
+        # records taken at the delivery boundary on BOTH inbound planes.
+        # Kill-switched like the flight recorder: capture_cap=0 keeps the
+        # hot path at a single attribute check.
+        self.capture_cap = capture_cap
+        self._capture = deque(maxlen=capture_cap) if capture_cap > 0 else None
+        self.captured = 0  # cumulative frames captured (past the ring)
 
     def stats(self) -> dict:
         return {
@@ -139,6 +150,27 @@ class Mesh:
             # not vanish from the operator's failure-detection signal
             "reader_drops": self._reader_drops_closed
             + sum(e[4] for e in self._native_by_fd.values()),
+            "captured": self.captured,
+        }
+
+    def _capture_frame(self, peer: Peer, frame: bytes) -> None:
+        self.captured += 1
+        self._capture.append(
+            (
+                int(self.clock.monotonic() * 1e9),
+                peer.sign_public.hex(),
+                frame[0] if frame else 0,
+                frame.hex(),
+            )
+        )
+
+    def capture_dump(self) -> dict:
+        """Snapshot of the inbound wire-capture ring (served on
+        /capturez; the input format of tools/capture_replay.py)."""
+        return {
+            "cap": self.capture_cap,
+            "captured": self.captured,
+            "records": [list(r) for r in (self._capture or ())],
         }
 
     async def start(self) -> None:
@@ -491,6 +523,8 @@ class Mesh:
             except Exception:
                 pass  # already logged by its own done-callback
         for frame in frames:
+            if self._capture is not None:
+                self._capture_frame(peer, frame)
             await self.on_frame(peer, frame)
 
     @staticmethod
@@ -532,6 +566,8 @@ class Mesh:
         try:
             while True:
                 frame = await channel.recv()
+                if self._capture is not None:
+                    self._capture_frame(peer, frame)
                 await self.on_frame(peer, frame)
         except (transport.ChannelClosed, ConnectionError):
             pass

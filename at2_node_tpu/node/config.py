@@ -21,7 +21,9 @@ Two conscious additions over the reference schema:
   `jax.profiler` trace of the verifier's device work is written there),
   `endpoints` (GET /metrics /healthz /statusz on the public RPC port),
   and `trace_sample` / `trace_cap` (tx-lifecycle tracer sampling and
-  cardinality bounds, obs/trace.py) —
+  cardinality bounds, obs/trace.py), plus the fleet-audit knobs
+  `audit_every` / `audit_interval` / `audit_history` / `capture_cap`
+  (state-digest beacons and the wire-capture ring, obs/audit.py) —
   SURVEY.md §5's "per-stage counters + jax.profiler from day 1";
 * an optional `[slo]` table — declarative service-level objectives
   (commit-latency p99 ceiling, throughput floor, rejection-rate ceiling,
@@ -123,7 +125,20 @@ class ObservabilityConfig:
     the event-loop lag probe (0 disables; the standing loop only runs on
     served nodes — never under sim); ``phase_accounting`` arms the plane
     time-accounting seam (phase counters accumulate under sim too — they
-    never feed the wire trace)."""
+    never feed the wire trace).
+
+    Fleet audit plane (obs/audit.py, TECHNICAL.md "Fleet audit &
+    incident capture"): ``audit_every`` emits a signed state-digest
+    beacon every Nth committed transfer (0 disables; commit-count
+    triggered, so emission is deterministic under sim and identical
+    across plane shard counts); ``audit_interval`` additionally paces a
+    wall-clock beacon on served nodes so an idle fleet still
+    cross-checks (0 disables; never runs under sim); ``audit_history``
+    bounds the local audit-point ring beacons are compared against.
+    ``capture_cap`` sizes the real Mesh's inbound wire-capture ring
+    ((mono_ns, peer, kind, frame) records served on /capturez, the
+    input to tools/capture_replay.py; 0 disables — the flight-recorder
+    kill-switch shape)."""
 
     stats_interval: float = 0.0  # seconds between stats lines; 0 = off
     profile_dir: str = ""  # jax.profiler trace output dir; "" = off
@@ -138,6 +153,10 @@ class ObservabilityConfig:
     profiler_duration: float = 10.0  # default capture length, seconds
     lag_probe_interval: float = 0.05  # event-loop lag probe pace; 0 = off
     phase_accounting: bool = True  # plane time-accounting seam
+    audit_every: int = 256  # beacon every Nth commit; 0 disables
+    audit_interval: float = 5.0  # idle-fleet beacon pace (served); 0 = off
+    audit_history: int = 512  # local audit points kept for comparison
+    capture_cap: int = 512  # inbound wire-capture ring size; 0 disables
 
     def __post_init__(self) -> None:
         if self.trace_sample < 0:
@@ -156,6 +175,14 @@ class ObservabilityConfig:
             raise ValueError("observability.profiler_duration must be > 0")
         if self.lag_probe_interval < 0:
             raise ValueError("observability.lag_probe_interval must be >= 0")
+        if self.audit_every < 0:
+            raise ValueError("observability.audit_every must be >= 0")
+        if self.audit_interval < 0:
+            raise ValueError("observability.audit_interval must be >= 0")
+        if self.audit_history < 8:
+            raise ValueError("observability.audit_history must be >= 8")
+        if self.capture_cap < 0:
+            raise ValueError("observability.capture_cap must be >= 0")
 
 
 @dataclass
@@ -475,6 +502,10 @@ class Config:
                 f"lag_probe_interval = {obs.lag_probe_interval}",
                 "phase_accounting = "
                 + ("true" if obs.phase_accounting else "false"),
+                f"audit_every = {obs.audit_every}",
+                f"audit_interval = {obs.audit_interval}",
+                f"audit_history = {obs.audit_history}",
+                f"capture_cap = {obs.capture_cap}",
             ]
         slo = self.slo
         if slo != SloConfig():

@@ -47,7 +47,10 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs.audit import directory_contrib
+
 _ZERO32 = b"\x00" * 32
+_M64 = (1 << 64) - 1
 
 # Per-stride registration cap: bounds the dense table (and every peer's
 # copy, and the checkpoint) at total * cap rows of 32 bytes. 2^18 rows
@@ -76,6 +79,12 @@ class ClientDirectory:
         # installed mappings per stride rank, the anchor of the
         # APPLY_GAP_SLACK bound (assign and apply both advance it)
         self._rank_applied: Dict[int, int] = {}
+        # Additive fleet-audit digest over installed bindings
+        # (obs/audit.py): bindings are install-once (first wins), so a
+        # u64 sum of per-binding contributions is order-independent and
+        # O(1) to maintain. Informational in beacon comparisons —
+        # directory gossip is eventually consistent.
+        self.digest = 0
 
     def __len__(self) -> int:
         return len(self._ids)
@@ -111,6 +120,7 @@ class ClientDirectory:
         self._keys[client_id] = np.frombuffer(pubkey, dtype=np.uint8)
         self._ids[pubkey] = client_id
         self._rank_applied[self.rank] = self._rank_applied.get(self.rank, 0) + 1
+        self.digest = (self.digest + directory_contrib(client_id, pubkey)) & _M64
         return client_id, True
 
     def apply(self, client_id: int, pubkey: bytes, rank: Optional[int] = None) -> bool:
@@ -139,6 +149,7 @@ class ClientDirectory:
         self._keys[client_id] = np.frombuffer(pubkey, dtype=np.uint8)
         self._ids.setdefault(pubkey, client_id)
         self._rank_applied[r] = self._rank_applied.get(r, 0) + 1
+        self.digest = (self.digest + directory_contrib(client_id, pubkey)) & _M64
         if r == self.rank:
             self._next_k = max(self._next_k, k + 1)
         return True

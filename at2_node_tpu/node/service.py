@@ -42,9 +42,11 @@ from ..broadcast.messages import (
     HistoryIndexRequest,
     HistoryRequest,
     Payload,
+    StateBeacon,
     TxBatch,
 )
 from ..broadcast.stack import Broadcast
+from ..crypto.keys import verify_one
 from ..crypto.verifier import Verifier
 from ..ledger import checkpoint as ckpt
 from ..ledger import history as hist
@@ -52,6 +54,7 @@ from ..ledger.accounts import AccountModificationError, Accounts
 from ..ledger.recent import RecentTransactions
 from ..net.peers import Mesh, Peer
 from ..net.webmux import PortMux
+from ..obs.audit import FleetAuditor
 from ..obs.profiler import (
     EventLoopLagProbe,
     PhaseAccounting,
@@ -249,6 +252,7 @@ class Service(At2Servicer):
             clock=self.clock,
         )
         self._slo_task: Optional[asyncio.Task] = None
+        self._audit_task: Optional[asyncio.Task] = None
         # the probe reads the commit-latency histogram TxTrace already
         # feeds; get-or-create by name returns that same instrument
         self._slo_hist = self.registry.histogram("tx_ingress_to_committed")
@@ -409,6 +413,30 @@ class Service(At2Servicer):
         self.store_stats = self.registry.counter_group(
             ("store_flushes", "store_segments_written", "store_segment_bytes")
         )
+        # Fleet consistency auditor (obs/audit.py): the additive digest
+        # lanes live on Accounts/ClientDirectory (maintained at the
+        # mutation sites); the auditor owns the chain head, the local
+        # audit-point history, peer-beacon comparison, and divergence
+        # attribution. Beacon emission: every `audit_every` commits
+        # (_commit_tail, deterministic under sim) plus a wall timer on
+        # served nodes (start()).
+        self.auditor = FleetAuditor(
+            self.accounts.digest, history_cap=obs.audit_history
+        )
+        # sim failpoint (sim/campaign.py planted_divergence_episode):
+        # callable (payload) -> balance delta misapplied to the
+        # recipient after a successful transfer; None = off
+        self.ledger_failpoint = None
+        self.registry.register_provider("audit_", self.auditor.stats)
+        self.registry.gauge(
+            "audit_divergence",
+            "1 when the auditor holds a confirmed peer divergence",
+            fn=lambda: 1 if self.auditor.divergence is not None else 0,
+        )
+        self.registry.gauge(
+            "audit_commits", "commits folded into the local digest chain",
+            fn=lambda: self.auditor.commits,
+        )
 
     # -- lifecycle --------------------------------------------------------
 
@@ -473,6 +501,7 @@ class Service(At2Servicer):
                     clock=service.clock,
                     region_fanout=config.wan.region_fanout,
                     region=config.wan.region,
+                    capture_cap=config.observability.capture_cap,
                 )
             plane_cfg = config.plane
             if plane_cfg.shards > 1:
@@ -536,6 +565,7 @@ class Service(At2Servicer):
                 service.verifier.phases = service.phases
             service.broadcast.catchup_handler = service._on_catchup
             service.broadcast.directory_handler = service._on_directory
+            service.broadcast.beacon_handler = service._on_beacon
             if service.store is not None:
                 # broadcast-safety floors: the slots this node attested
                 # before the crash are fenced — a restarted node never
@@ -630,6 +660,15 @@ class Service(At2Servicer):
             # drive probe_once() manually instead)
             if serve_rpc and service.lag_probe is not None:
                 service.lag_probe.start()
+            # idle-fleet audit beacons: served nodes only, same reasoning
+            # as the SLO probe (sim emission is commit-count triggered in
+            # _commit_tail, keeping every sim schedule timer-free)
+            if serve_rpc and config.observability.audit_interval > 0:
+                service._audit_task = asyncio.create_task(
+                    service._audit_beacon_loop(
+                        config.observability.audit_interval
+                    )
+                )
             if obs.profile_dir:
                 import jax
 
@@ -695,6 +734,12 @@ class Service(At2Servicer):
             self._slo_task.cancel()
             try:
                 await self._slo_task
+            except asyncio.CancelledError:
+                pass
+        if self._audit_task is not None:
+            self._audit_task.cancel()
+            try:
+                await self._audit_task
             except asyncio.CancelledError:
                 pass
         if self.lag_probe is not None:
@@ -853,6 +898,10 @@ class Service(At2Servicer):
         await self.accounts.import_state(store.accounts_state())
         await self.recent.import_state(store.recent_rows)
         self.directory.import_(store.directory_rows)
+        # the additive digest lanes were reseeded by the imports above
+        # (Accounts.import_state / ClientDirectory.apply maintain them);
+        # resume the persisted local chain head with a restart marker
+        self.auditor.restore(store.audit)
         # refill the catchup serving store from persisted history so a
         # restarted node can serve peers (and the conservation invariant
         # can replay) without waiting for new commits
@@ -917,6 +966,7 @@ class Service(At2Servicer):
             watermarks=watermarks,
             distill_seen=[[cid, seq] for cid, seq in seen],
             epoch=self.membership.epoch if self.membership else None,
+            audit=self.auditor.export(),
         )
         stats = self.store.flush()
         if stats:
@@ -1116,6 +1166,21 @@ class Service(At2Servicer):
                     k, _, v = part.partition("=")
                     params[k] = v
             return self.profilez(params)
+        if route == "/capturez":
+            # inbound wire-capture ring (net/peers.py): kill-switched
+            # like the flight recorder — capture_cap=0 (or a sim mesh,
+            # which has no ring) means the endpoint does not exist
+            dump = getattr(self.mesh, "capture_dump", None)
+            if dump is None or getattr(self.mesh, "_capture", None) is None:
+                return None
+            body = json.dumps(
+                {
+                    "node": self.config.sign_key.public.hex()[:16],
+                    **dump(),
+                },
+                sort_keys=True,
+            ).encode()
+            return 200, self._OBS_JSON, body
         return None
 
     def profilez(self, params: dict | None = None):
@@ -1221,10 +1286,16 @@ class Service(At2Servicer):
         # transient spike cannot flip this) marks the node degraded even
         # when quorum and the commit heap look healthy.
         slo_breach = self.slo.breaching(now)
+        # a latched audit divergence is a safety signal, not a liveness
+        # one: the ledgers have provably forked at a shared coordinate
+        # (obs/audit.py zero-false-positive compare), so the node must
+        # fail probes until an operator intervenes
+        diverged = self.auditor.divergence is not None
         ok = (
             quorum_ok
             and not stalled
             and not slo_breach
+            and not diverged
             and not self._closing
         )
         # a store-backed restart reports "recovering" until catchup lag
@@ -1238,7 +1309,9 @@ class Service(At2Servicer):
         # on the transition, so a poll loop hammering a degraded node
         # takes ONE snapshot per incident, not one per scrape.
         if not ok and self._health_was_ok and not self._closing:
-            if stalled:
+            if diverged:
+                reason = "diverged"
+            elif stalled:
                 reason = "stalled"
             elif not quorum_ok:
                 reason = "quorum_lost"
@@ -1260,7 +1333,12 @@ class Service(At2Servicer):
                     duration=self.config.observability.profiler_duration
                 )
         self._health_was_ok = ok
-        if not ok:
+        if diverged:
+            # distinct from "degraded": liveness may be perfect while
+            # the state has forked, and operators triage the two very
+            # differently (restart vs incident bundle + capture replay)
+            status = "diverged"
+        elif not ok:
             status = "degraded"
         elif recovering:
             status = "recovering"
@@ -1276,6 +1354,7 @@ class Service(At2Servicer):
             "quorum_ok": quorum_ok,
             "stalled": stalled,
             "slo_breach": slo_breach,
+            "divergence": self.auditor.divergence,
             "pending": len(self._heap),
             "committed": self.committed,
             "uptime_s": round(now - self._started_at, 3),
@@ -1326,6 +1405,9 @@ class Service(At2Servicer):
             "membership": (
                 self.membership.stats() if self.membership else {}
             ),
+            # fleet-audit block (obs/audit.py): digest lanes, chain
+            # head, peer beacon summaries, and any latched divergence
+            "audit": self.auditor.status(self.directory.digest),
             # sharded-plane block (tools/top.py `shards` column); the
             # monolithic plane has no plane_info and reports shards=1
             "plane": (
@@ -1489,6 +1571,19 @@ class Service(At2Servicer):
                         logger.warning("dropping bad payload: %s", exc)
                         drops.append(payload)
                         continue
+                    if self.ledger_failpoint is not None:
+                        # sim-only corruption seam (sim/campaign.py
+                        # "misapply" event): misapply a balance delta to
+                        # the recipient AFTER a successful transfer,
+                        # BEFORE the post-commit balance capture — the
+                        # WAL, the ring, and the digest all see the
+                        # corrupted state consistently, so only peers'
+                        # auditors can catch it (which is the point).
+                        delta = self.ledger_failpoint(payload)
+                        if delta:
+                            accounts._tamper(
+                                payload.transaction.recipient, delta
+                            )
                     ring_ops.append(
                         (
                             "update",
@@ -1597,8 +1692,87 @@ class Service(At2Servicer):
                 self.broadcast.release_entry(payload.sender, payload.sequence)
         if ring_ops:
             await self.recent.apply_many(ring_ops)
+        if commits:
+            # commit-count-triggered audit beacon: every node emits at
+            # the same committed-transfer strides, so the sim exercises
+            # the full beacon/compare path without any standing timer
+            # (and identically at plane shards 1 vs 4 — the commit order
+            # is identical, hence so are the emission points).
+            every = self.config.observability.audit_every
+            before = self.auditor.commits
+            self.auditor.note_commit(len(commits))
+            if every > 0 and before // every != self.auditor.commits // every:
+                self._emit_beacon()
         if ph is not None:
             ph.add("commit_tail", t0)
+
+    # -- fleet consistency audit (obs/audit.py) ---------------------------
+
+    def _emit_beacon(self) -> None:
+        """Fold a local audit point and gossip it as a signed
+        StateBeacon (wire kind 15). Called from _commit_tail at
+        audit_every commit strides and from the wall timer on served
+        nodes; safe pre-mesh (the point still lands in local history,
+        so late peers' beacons at that watermark remain comparable)."""
+        epoch = self.membership.epoch if self.membership is not None else 0
+        point = self.auditor.snapshot(epoch, self.directory.digest)
+        if self.mesh is None or not self.mesh.peers:
+            return
+        beacon = StateBeacon.create(
+            self.config.sign_key,
+            epoch,
+            point["commits"],
+            point["wm"],
+            point["ranges"],
+            point["dir"],
+            point["chain"],
+        )
+        self.auditor.counters["beacons_tx"] += 1
+        self.mesh.broadcast(beacon.encode())
+
+    def _on_beacon(self, peer: Peer, msg: StateBeacon) -> None:
+        """Broadcast-plane hook for inbound StateBeacons. The origin must
+        be a KNOWN member sign key but deliberately not the transport
+        peer: a relayed or replayed beacon (tools/capture_replay.py
+        injects captures through a synthetic identity) still exercises
+        the auditor, and the ed25519 signature alone binds the claims."""
+        origin = bytes(msg.origin)
+        if (
+            origin not in self._node_ranks
+            or origin == self.config.sign_key.public
+            or not verify_one(origin, msg.to_sign(), msg.signature)
+        ):
+            self.auditor.counters["beacon_invalid"] += 1
+            return
+        divergence = self.auditor.observe(
+            origin.hex(),
+            {
+                "epoch": msg.epoch,
+                "commits": msg.commits,
+                "wm": bytes(msg.wm_digest),
+                "ranges": bytes(msg.ranges),
+                "dir": bytes(msg.dir_digest),
+                "chain": bytes(msg.chain),
+            },
+        )
+        if divergence is not None:
+            logger.warning(
+                "fleet divergence: peer=%s ranges=%s wm=%s",
+                divergence["peer"][:16],
+                divergence["ranges"],
+                divergence["wm"][:16],
+            )
+            self.recorder.snapshot("audit_divergence")
+
+    async def _audit_beacon_loop(self, interval: float) -> None:
+        """Wall-timer beacon emission for served nodes: an idle fleet
+        (no commits, so no stride triggers) still cross-checks state."""
+        while True:
+            await self.clock.sleep(interval)
+            try:
+                self._emit_beacon()
+            except Exception:
+                logger.exception("audit beacon emission failed")
 
     # -- ledger-history catchup ------------------------------------------
     #

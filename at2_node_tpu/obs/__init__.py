@@ -4,6 +4,7 @@ registry.py / trace.py / recorder.py / slo.py module docstrings and the
 TECHNICAL.md "Observability" and "Fleet tracing & flight recorder"
 sections for the contracts."""
 
+from .audit import AUDIT_RANGES, FleetAuditor, LedgerDigest
 from .profiler import (
     PHASES,
     PLANE_LEAF_PHASES,
@@ -24,11 +25,14 @@ from .slo import Objective, SloEngine, default_objectives, evaluate_point
 from .trace import BROKER_STAGES, REJECTED, STAGES, TxTrace
 
 __all__ = [
+    "AUDIT_RANGES",
     "BROKER_STAGES",
     "Counter",
     "CounterGroup",
     "EventLoopLagProbe",
+    "FleetAuditor",
     "FlightRecorder",
+    "LedgerDigest",
     "Gauge",
     "Histogram",
     "Objective",

@@ -442,6 +442,11 @@ class EpisodeResult:
     # stitched timeline of every traced tx (tools/trace_collect.stitch).
     # Deterministic under sim virtual time — same seed, same artifact.
     obs: Optional[dict] = None
+    # per-node fleet-audit state at quiescence (obs/audit.py): latched
+    # divergence record, beacon counters, and the order-independent
+    # digest coordinates — what the CI audit gate and the shard/wan
+    # digest-equality tests assert on.
+    audit: Optional[List[dict]] = None
 
     @property
     def ok(self) -> bool:
@@ -462,6 +467,7 @@ class EpisodeResult:
             "events": self.events,
             "minimized": self.minimized,
             "obs": self.obs,
+            "audit": self.audit,
         }
 
 
@@ -800,6 +806,26 @@ def apply_events(
                 net.fabric.inject(src, node_sign(args["target"]), frame)
 
             loop.call_later(t, inject)
+        elif kind == "misapply":
+            # arm one node's ledger failpoint (node/service.py
+            # _apply_pass): the next `count` successful transfers it
+            # commits misapply `delta` to the recipient's balance —
+            # a silent local corruption only the fleet auditor's
+            # cross-node beacon compare can catch.
+            def misapply(args=args):
+                svc = net.services[args["node"]]
+                remaining = [int(args.get("count", 1))]
+                delta = int(args["delta"])
+
+                def failpoint(_payload, _r=remaining):
+                    if _r[0] <= 0:
+                        return 0
+                    _r[0] -= 1
+                    return delta
+
+                svc.ledger_failpoint = failpoint
+
+            loop.call_later(t, misapply)
         else:
             raise ValueError(f"unknown event kind: {kind}")
 
@@ -980,6 +1006,26 @@ def run_episode(
         net.run_for(last_t + 1.0)
         net.fabric.heal_all()
         virtual = last_t + 1.0 + net.settle(horizon=settle_horizon)
+        # fleet-audit sweep at quiescence: every live node beacons its
+        # FINAL frontier (production's wall timer does this on served
+        # nodes; sim schedules are timer-free), so matched-watermark
+        # comparisons always happen at least once per episode no matter
+        # how the mid-run commit-stride beacons interleaved.
+        for i, svc in enumerate(net.services):
+            if i not in net.down:
+                svc._emit_beacon()
+        net.settle(horizon=10.0)
+        audit = [
+            {
+                "divergence": svc.auditor.divergence,
+                "counters": svc.auditor.stats(),
+                "commits": svc.auditor.commits,
+                "wm": svc.accounts.digest.wm,
+                "ranges": list(svc.accounts.digest.ranges),
+                "dir": svc.directory.digest,
+            }
+            for svc in net.services
+        ]
         violations = net.check_invariants()
         if broker:
             violations += _forged_commit_sweep(net)
@@ -1007,6 +1053,7 @@ def run_episode(
             virtual_time=virtual,
             wall_seconds=time.monotonic() - wall0,
             obs=obs,
+            audit=audit,
         )
     finally:
         net.close()
@@ -1083,6 +1130,46 @@ def planted_breach_episode(
         ready_threshold=1,
         config_overrides={"batching": BatchingConfig(enabled=False)},
         settle_horizon=40.0,
+        capture_obs=capture_obs,
+    )
+
+
+def planted_divergence_episode(
+    seed: int = 20260805, *, capture_obs: Optional[bool] = None
+) -> EpisodeResult:
+    """The canonical planted STATE divergence, as a one-call reproducer:
+    a clean 3-node fleet runs serialized honest traffic (client 0 pays
+    client 1, one transfer settling fully before the next), and at
+    t=2.6 node 0's ledger failpoint is armed to misapply a +7 balance
+    delta to the recipient of its next committed transfer — a silent
+    local corruption that is consistent across node 0's own WAL, ring,
+    and digest, so only the fleet auditor's cross-node beacon compare
+    (obs/audit.py, ``audit_every=8``) can catch it.
+
+    The episode FAILS the invariant sweep by design (the fork is real:
+    balance agreement breaks at quiescence); the point of the episode
+    is what the ``audit`` block shows — both honest nodes latch a
+    divergence attributing node 0, the recipient's account-range lane,
+    and the first divergent watermark, within two beacon intervals of
+    the corruption. scripts/ci.sh's fleet-audit gate and
+    tests/test_sim.py assert exactly that."""
+    from ..node.config import ObservabilityConfig
+
+    events: List[Event] = [
+        [0.5 + 0.5 * k, "tx",
+         {"node": k % 3, "client": 0, "seq": k + 1, "to": 1, "amount": 1}]
+        for k in range(40)
+    ]
+    events.append([2.6, "misapply", {"node": 0, "delta": 7, "count": 1}])
+    events.sort(key=lambda e: (e[0], e[1]))
+    return run_episode(
+        seed,
+        nodes=3,
+        f=0,
+        hostile=0,
+        events=events,
+        config_overrides={"observability": ObservabilityConfig(audit_every=8)},
+        settle_horizon=60.0,
         capture_obs=capture_obs,
     )
 

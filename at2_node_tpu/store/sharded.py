@@ -93,6 +93,9 @@ class ShardedStore:
         self.recent_rows: list = []
         self.watermarks: dict = {"tx": {}, "batch": {}}
         self.distill_seen: list = []
+        # fleet-audit chain persistence (obs/audit.py export/restore):
+        # {"chain": hex, "commits": int} — restart tamper evidence
+        self.audit: dict = {}
         self.wal_replayed = 0  # records replayed by the last open()
         self.segments_loaded = 0  # segments read by the last open()
         self.migrated = False  # open() imported a legacy checkpoint
@@ -158,6 +161,7 @@ class ShardedStore:
         store.recent_rows = doc.get("recent", [])
         store.watermarks = doc.get("watermarks", {"tx": {}, "batch": {}})
         store.distill_seen = doc.get("distill_seen", [])
+        store.audit = doc.get("audit", {})
         store._parked = dict.fromkeys(doc.get("parked", []))
         store._segments = dict(doc.get("segments", {}))
 
@@ -256,6 +260,7 @@ class ShardedStore:
         watermarks: Optional[dict] = None,
         distill_seen: Optional[list] = None,
         epoch: Optional[int] = None,
+        audit: Optional[dict] = None,
     ) -> None:
         """Refresh the small state the manifest carries (called by the
         service right before a flush)."""
@@ -269,6 +274,8 @@ class ShardedStore:
             self.distill_seen = distill_seen
         if epoch is not None:
             self.epoch = epoch
+        if audit is not None:
+            self.audit = audit
         self._meta_dirty = True
 
     def flush(self, force: bool = False) -> Optional[dict]:
@@ -383,6 +390,7 @@ class ShardedStore:
             "recent": self.recent_rows,
             "watermarks": self.watermarks,
             "distill_seen": self.distill_seen,
+            "audit": self.audit,
             "parked": list(self._parked),
             "accounts_total": self.account_count(),
         }

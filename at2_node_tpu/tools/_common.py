@@ -2,13 +2,71 @@
 
 from __future__ import annotations
 
+import asyncio
 import itertools
+import json
 import os
 from typing import Iterator, List
 
 from ..crypto.keys import ExchangeKeyPair, SignKeyPair
 from ..net.peers import Peer
 from ..node.config import Config
+
+_GET_TIMEOUT = 5.0
+
+
+async def fetch_json(host: str, port: int, path: str,
+                     timeout: float = _GET_TIMEOUT):
+    """One raw HTTP/1 GET of a JSON obs endpoint (no http client
+    dependency) — THE fleet-polling primitive, shared by top,
+    trace_collect, profile_collect, and the incident collector."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    try:
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+            "Connection: close\r\n\r\n".encode()
+        )
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status_line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+    if " 200 " not in f"{status_line} ":
+        raise RuntimeError(f"{host}:{port} answered {status_line!r}")
+    return json.loads(body)
+
+
+async def fetch_statusz(host: str, port: int, timeout: float = _GET_TIMEOUT):
+    """One raw HTTP/1 GET /statusz."""
+    return await fetch_json(host, port, "/statusz", timeout)
+
+
+def parse_addr(spec: str):
+    host, _, port = spec.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad address {spec!r}, want HOST:PORT")
+    return host, int(port)
+
+
+async def poll_fleet(addrs, path: str, timeout: float = _GET_TIMEOUT) -> list:
+    """GET ``path`` from every (host, port) concurrently. Returns one
+    entry per address: the parsed JSON, or ``{"error": str}`` for a node
+    that did not answer — collectors keep going with a partial fleet."""
+    results = await asyncio.gather(
+        *(fetch_json(h, p, path, timeout) for h, p in addrs),
+        return_exceptions=True,
+    )
+    return [
+        {"error": str(r)} if isinstance(r, BaseException) else r
+        for r in results
+    ]
 
 
 def host_context() -> dict:

@@ -107,10 +107,13 @@ async def run(
             if obs
             # the off arm zeroes EVERY observability seam, the profiler
             # tier included: no lifecycle tracer, no flight recorder, no
-            # phase accounting, no lag probe, no /profilez
+            # phase accounting, no lag probe, no /profilez, no audit
+            # beacons, no wire-capture ring — so the measured on-arm
+            # delta prices the WHOLE tier, fleet audit included
             else ObservabilityConfig(
                 trace_sample=0, recorder_cap=0, profilez=False,
                 lag_probe_interval=0.0, phase_accounting=False,
+                audit_every=0, audit_interval=0.0, capture_cap=0,
             )
         ),
         # the off arm silences the SLO probe loop too: "obs off" means
@@ -207,6 +210,17 @@ async def run(
             # the active verifier's own pipeline counters (occupancy,
             # padding, per-stage ms) — empty for --verifier plane-only
             "verifier_stats": vstats,
+            # fleet-audit + wire-capture activity in the measured run:
+            # proves the obs-on arm actually paid for beacons/capture
+            # rather than idling them (audit_every=256 fires on a 300-tx
+            # firehose; the off arm zeroes both)
+            "audit": {
+                "beacons_tx": stats.get("audit_beacons_tx", 0),
+                "beacons_rx": stats.get("audit_beacons_rx", 0),
+                "compared": stats.get("audit_compared", 0),
+                "diverged": stats.get("audit_diverged", 0),
+                "captured_frames": stats.get("mesh_captured", 0),
+            },
             # headline latency row (ISSUE 3 satellite): BENCH_* files
             # carry latency, not just throughput
             "latency": {
@@ -240,11 +254,13 @@ def compare_obs(
     one — and check the on-arm's regression against the budget."""
     arms: dict = {"on": [], "off": []}
     samples = 0
+    audit_on: dict = {}
     for _ in range(repeat):
         for obs in (True, False):
             # the measured arm carries the FULL observability tier:
             # tracer, recorder, SLO probes, phase accounting, the
-            # event-loop lag probe, and a live stack sampler
+            # event-loop lag probe, a live stack sampler, audit
+            # beacons, and the inbound wire-capture ring
             res = asyncio.run(
                 run(nodes, txs, verifier, timeout, batch, obs=obs,
                     profile=obs)
@@ -257,6 +273,9 @@ def compare_obs(
             arms["on" if obs else "off"].append(res["committed_tx_per_sec"])
             if res["profiler"]:
                 samples += res["profiler"]["samples"]
+            if obs:
+                for k, v in res["audit"].items():
+                    audit_on[k] = audit_on.get(k, 0) + v
     best_on, best_off = max(arms["on"]), max(arms["off"])
     overhead_pct = (
         round(100.0 * (1.0 - best_on / best_off), 2) if best_off else 0.0
@@ -271,6 +290,9 @@ def compare_obs(
         "rates_on": arms["on"],
         "rates_off": arms["off"],
         "sampler_samples_on": samples,
+        # summed over the on-arm runs: nonzero beacons/captures prove
+        # the priced tier actually included the fleet auditor + capture
+        "audit_on": audit_on,
         "best_on_tx_per_sec": best_on,
         "best_off_tx_per_sec": best_off,
         "overhead_pct": overhead_pct,

@@ -38,7 +38,7 @@ import json
 import sys
 
 from ..obs.profiler import PLANE_LEAF_PHASES, PHASES, build_info
-from .top import _parse_addr, fetch_json
+from ._common import fetch_json, parse_addr as _parse_addr
 
 _OFF_PLANE = tuple(
     p for p in PHASES if p not in PLANE_LEAF_PHASES and p != "plane_total"

@@ -393,6 +393,16 @@ def load_obs_overhead(name: str, doc: dict) -> List[dict]:
     _require(doc, "config", name)
     _num(doc, "overhead_pct", name)
     _num(doc, "budget_pct", name)
+    # fleet-audit activity block (optional: pre-auditor banks lack it).
+    # Schema-only validation — beacon/capture counts label what the
+    # measured tier contained, they are not a judged series.
+    audit = doc.get("audit_on")
+    if audit is not None:
+        for key in ("beacons_tx", "captured_frames"):
+            if not isinstance(audit.get(key), (int, float)):
+                raise ValueError(
+                    f"{name}: audit_on.{key} missing or non-numeric"
+                )
     comp = (
         f"nodes={doc.get('nodes')} batch={doc.get('batch')} "
         f"submitted={doc.get('submitted')}"

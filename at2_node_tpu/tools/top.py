@@ -44,46 +44,11 @@ import json
 import sys
 import time
 
-_GET_TIMEOUT = 5.0
-
-
-async def fetch_json(host: str, port: int, path: str,
-                     timeout: float = _GET_TIMEOUT):
-    """One raw HTTP/1 GET of a JSON obs endpoint (no http client
-    dependency) — shared by top, trace_collect, and the benches."""
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, port), timeout
-    )
-    try:
-        writer.write(
-            f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
-            "Connection: close\r\n\r\n".encode()
-        )
-        await writer.drain()
-        raw = await asyncio.wait_for(reader.read(), timeout)
-    finally:
-        writer.close()
-        try:
-            await writer.wait_closed()
-        except Exception:
-            pass
-    head, _, body = raw.partition(b"\r\n\r\n")
-    status_line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
-    if " 200 " not in f"{status_line} ":
-        raise RuntimeError(f"{host}:{port} answered {status_line!r}")
-    return json.loads(body)
-
-
-async def fetch_statusz(host: str, port: int, timeout: float = _GET_TIMEOUT):
-    """One raw HTTP/1 GET /statusz."""
-    return await fetch_json(host, port, "/statusz", timeout)
-
-
-def _parse_addr(spec: str):
-    host, _, port = spec.rpartition(":")
-    if not host or not port.isdigit():
-        raise ValueError(f"bad address {spec!r}, want HOST:PORT")
-    return host, int(port)
+# The raw-HTTP polling primitives live in tools/_common.py (shared with
+# trace_collect, profile_collect, and the incident collector); re-
+# exported here because external scripts import them from tools.top.
+from ._common import _GET_TIMEOUT, fetch_json, fetch_statusz
+from ._common import parse_addr as _parse_addr
 
 
 def _num(snapshot: dict, key: str, default=0):

@@ -44,7 +44,7 @@ import json
 import math
 import sys
 
-from .top import fetch_json
+from ._common import fetch_json, parse_addr as _parse_addr
 
 # ladder order for sorting stages within a (tx, node) span; the broker
 # hop precedes node ingress on the distilled path; rejected sits past
@@ -455,13 +455,6 @@ def chrome_trace(stitched: dict) -> dict:
 
 
 # -- CLI ------------------------------------------------------------------
-
-
-def _parse_addr(spec: str):
-    host, _, port = spec.rpartition(":")
-    if not host or not port.isdigit():
-        raise ValueError(f"bad address {spec!r}, want HOST:PORT")
-    return host, int(port)
 
 
 async def collect(addrs, limit, timeout: float = 5.0) -> list:

@@ -242,11 +242,108 @@ assert p99 < 1000.0, f"sub-second WAN finality missed: p99 {p99} ms"
 print(f"wan3 steady +wan: p99 {p99} ms < 1000 ms, SLO ok")
 EOF
 
+echo "== fleet-audit gate =="
+# Fleet consistency auditor + capture/replay bridge (ISSUE 15), three
+# contracts:
+#  1. a planted single-node ledger corruption (consistent across the
+#     culprit's own WAL/ring/digest, so only cross-node beacon compare
+#     can see it) must be DETECTED by both honest peers within two
+#     beacon intervals and ATTRIBUTED to the culprit node and the
+#     victim's account-range lane;
+#  2. zero false positives: clean adversarial, sharded-plane, and
+#     wan-levers episodes must all end with no latched divergence;
+#  3. a wire capture taken from a real-socket fleet must replay through
+#     the sim bridge to the same verdict hash twice.
+python - <<'EOF'
+from at2_node_tpu.sim.campaign import planted_divergence_episode
+from at2_node_tpu.sim.net import sim_keypairs, sim_client
+
+seed = 20260805
+r = planted_divergence_episode(seed)
+assert r.violations, "planted divergence must fail the invariant sweep"
+culprit = sim_keypairs(seed, 0)[0].public.hex()
+victim_lane = sim_client(seed, 1).public[0] >> 4
+assert r.audit is not None
+honest = r.audit[1:]
+for a in honest:
+    d = a["divergence"]
+    assert d is not None, "honest node failed to latch the divergence"
+    assert d["peer"] == culprit, f"wrong attribution: {d['peer'][:12]}"
+    assert victim_lane in d["ranges"], (victim_lane, d["ranges"])
+    assert d["detected_commits"] - 6 <= 16, d  # two beacon intervals of 8
+print("planted divergence: attributed to node 0, lane", victim_lane,
+      "at commit", honest[0]["divergence"]["detected_commits"])
+EOF
+python - <<'EOF'
+from at2_node_tpu.node.config import ObservabilityConfig, WanConfig
+from at2_node_tpu.sim.campaign import run_episode
+
+obs = {"observability": ObservabilityConfig(audit_every=8)}
+cells = {
+    "adversarial": dict(config_overrides=dict(obs)),
+    "sharded": dict(config_overrides={**obs, "plane_shards": 4}),
+    "wan": dict(config_overrides={
+        **obs, "wan": WanConfig(overlap_ready=True, region_fanout=True)}),
+}
+for name, kw in cells.items():
+    r = run_episode(11, n_events=12, duration=8.0, settle_horizon=60.0, **kw)
+    assert not r.violations, (name, r.violations)
+    for a in r.audit:
+        assert a["divergence"] is None, (name, a["divergence"])
+        assert a["counters"]["diverged"] == 0, (name, a["counters"])
+    print(f"clean {name} episode: zero false positives "
+          f"({sum(a['counters']['compared'] for a in r.audit)} compares)")
+EOF
+python - <<'EOF'
+import asyncio, time
+from at2_node_tpu.broadcast.messages import Payload
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.tools._common import make_net_configs, port_counter
+from at2_node_tpu.tools.capture_replay import replay_capture, verdict_hash
+from at2_node_tpu.types import ThinTransaction
+
+async def capture():
+    cfgs = make_net_configs(3, port_counter(28400))
+    services = []
+    try:
+        for c in cfgs:
+            services.append(await Service.start(c))
+        sender = SignKeyPair.from_hex("66" * 32)
+        recipient = SignKeyPair.from_hex("67" * 32).public
+        for seq in range(1, 25):
+            await services[0].broadcast.broadcast(
+                Payload.create(sender, seq, ThinTransaction(recipient, 1)))
+        t0 = time.monotonic()
+        while any(s.committed < 24 for s in services):
+            await asyncio.sleep(0.02)
+            assert time.monotonic() - t0 < 120, "fleet did not commit"
+        for s in services:
+            s._emit_beacon()
+        await asyncio.sleep(0.3)
+        return services[1].mesh.capture_dump()
+    finally:
+        for s in services:
+            await s.close()
+
+doc = asyncio.run(capture())
+assert doc["records"], "capture ring stayed empty"
+v1 = replay_capture(doc, 5)
+v2 = replay_capture(doc, 5)
+h1, h2 = verdict_hash(v1), verdict_hash(v2)
+assert h1 == h2, (h1, h2)
+assert not v1["violations"], v1["violations"]
+print(f"capture of {len(doc['records'])} frames replayed to verdict "
+      f"{h1[:16]} twice")
+EOF
+
 echo "== observability overhead gate =="
-# The full tracer + recorder + SLO probe cost, measured as plane
-# throughput with observability on vs off (interleaved arms, best-of-N
-# per arm to shed scheduler noise), must stay under the 5% budget.
-# Exit nonzero when the obs-on arm regresses past --budget.
+# The full observability tier's cost — tracer, recorder, SLO probes,
+# phase accounting, lag probe, sampler, audit beacons, and the inbound
+# wire-capture ring — measured as plane throughput with the tier on vs
+# off (interleaved arms, best-of-N per arm to shed scheduler noise),
+# must stay under the 5% budget. Exit nonzero when the obs-on arm
+# regresses past --budget.
 python -m at2_node_tpu.tools.plane_bench --compare-obs --nodes 3 \
     --txs 200 --repeat 2 --out /dev/null
 

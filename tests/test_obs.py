@@ -837,3 +837,317 @@ class TestEndpoints:
                 "catchup_served", "rejected_at_ingress",
             }
             assert "tx_ingress_to_committed_p50_ms" in after
+
+
+# ------------------------------------------------------------- fleet audit
+
+
+class TestFleetAudit:
+    """Unit tier for obs/audit.py: contribution rules, order
+    independence, the zero-false-positive compare, and attribution."""
+
+    def test_initial_balance_pinned_to_ledger(self):
+        # obs/ is a leaf package, so audit.py duplicates the ledger's
+        # INITIAL_BALANCE instead of importing it; this pin is the
+        # compile-time guard that the copies never drift (a drift would
+        # silently break the virgin-row rule below)
+        from at2_node_tpu.ledger.account import INITIAL_BALANCE as ledger_ib
+        from at2_node_tpu.obs.audit import INITIAL_BALANCE as audit_ib
+
+        assert audit_ib == ledger_ib
+
+    def test_virgin_row_contributes_zero(self):
+        from at2_node_tpu.obs.audit import (
+            INITIAL_BALANCE,
+            account_contrib,
+            watermark_contrib,
+        )
+
+        key = bytes(range(32))
+        # row creation timing differs across nodes (failed applies make
+        # rows as a side effect), so an untouched row must be invisible
+        assert account_contrib(key, 0, INITIAL_BALANCE) == 0
+        assert watermark_contrib(key, 0) == 0
+        # any observable state change shows
+        assert account_contrib(key, 1, INITIAL_BALANCE) != 0
+        assert account_contrib(key, 0, INITIAL_BALANCE - 1) != 0
+        assert watermark_contrib(key, 1) != 0
+
+    def test_digest_is_order_independent(self):
+        from at2_node_tpu.obs.audit import LedgerDigest
+
+        moves = [
+            (bytes([i]) * 32, s, 100_000 + d, s + 1, 100_000 + d - 7)
+            for i in (3, 200, 77)
+            for s, d in ((0, 0), (1, -7), (2, -14))
+        ]
+        a, b = LedgerDigest(), LedgerDigest()
+        for m in moves:
+            a.touch(*m)
+        for m in reversed(moves):
+            b.touch(*m)
+        assert a.ranges == b.ranges
+        assert a.wm == b.wm
+        # reseed from the final rows reproduces the incremental digest
+        c = LedgerDigest()
+        c.reseed((bytes([i]) * 32, 3, 100_000 - 21) for i in (3, 200, 77))
+        assert c.ranges == a.ranges and c.wm == a.wm
+
+    @staticmethod
+    def _beacon_fields(point):
+        return {
+            "epoch": point["epoch"],
+            "commits": point["commits"],
+            "wm": point["wm"],
+            "ranges": point["ranges"],
+            "dir": point["dir"],
+            "chain": point["chain"],
+        }
+
+    def test_matching_peers_never_diverge(self):
+        from at2_node_tpu.obs.audit import FleetAuditor, LedgerDigest
+
+        da, db = LedgerDigest(), LedgerDigest()
+        key = bytes([16]) * 32
+        for d in (da, db):
+            d.touch(key, 0, 100_000, 1, 99_000)
+        a, b = FleetAuditor(da), FleetAuditor(db)
+        a.note_commit()
+        b.note_commit()
+        pb = b.snapshot(0, 0)
+        assert a.observe("bb", self._beacon_fields(pb)) is None  # parked
+        a.snapshot(0, 0)  # local point lands -> parked beacon settles
+        assert a.counters["compared"] == 1
+        assert a.counters["matched"] == 1
+        assert a.divergence is None
+        # chain heads are order-dependent local evidence, never compared
+        assert a.chain != b.chain or a.chain == b.chain  # both legal
+
+    def test_divergence_detected_and_attributed(self):
+        from at2_node_tpu.obs.audit import FleetAuditor, LedgerDigest
+
+        da, db = LedgerDigest(), LedgerDigest()
+        key = bytes([0x42]) * 32  # lane 4
+        for d in (da, db):
+            d.touch(key, 0, 100_000, 1, 99_000)
+        # same watermark, corrupted balance on b: the only digest
+        # coordinate where a mismatch is a REAL divergence
+        db.touch(key, 1, 99_000, 1, 99_007)
+        a, b = FleetAuditor(da), FleetAuditor(db)
+        a.note_commit()
+        b.note_commit()
+        pa = a.snapshot(3, 0)
+        rec = b.observe("aa", self._beacon_fields(pa))
+        assert rec is None  # parked until b folds the same watermark
+        b.snapshot(3, 0)
+        assert b.divergence is not None
+        assert b.divergence["peer"] == "aa"
+        assert b.divergence["ranges"] == [4]
+        assert b.divergence["epoch"] == 3
+        assert b.counters["diverged"] == 1
+        # latched: a later matching beacon does not clear the record
+        first = dict(b.divergence)
+        assert b.divergence == first
+
+    def test_epoch_and_dir_skew_are_informational(self):
+        from at2_node_tpu.obs.audit import FleetAuditor, LedgerDigest
+
+        d = LedgerDigest()
+        d.touch(bytes([1]) * 32, 0, 100_000, 1, 99_000)
+        a = FleetAuditor(d)
+        p = a.snapshot(1, 7)
+        # same wm, different epoch: incomparable, never divergence
+        other = dict(self._beacon_fields(p), epoch=2)
+        assert a.observe("bb", other) is None
+        assert a.counters["epoch_skew"] == 1
+        assert a.counters["compared"] == 0
+        # same wm + ranges, different dir: eventual-consistency skew
+        skew = dict(self._beacon_fields(p), dir=b"\x09" * 8)
+        assert a.observe("cc", skew) is None
+        assert a.counters["dir_skew"] == 1
+        assert a.divergence is None
+
+    def test_restore_folds_restart_marker(self):
+        from at2_node_tpu.obs.audit import FleetAuditor, LedgerDigest
+
+        a = FleetAuditor(LedgerDigest())
+        a.note_commit(5)
+        a.snapshot(0, 0)
+        doc = a.export()
+        b = FleetAuditor(LedgerDigest())
+        b.restore(doc)
+        assert b.commits == 5
+        # a restarted chain is tamper-evidently distinct from the
+        # continuous one it resumed
+        assert b.chain != bytes.fromhex(doc["chain"])
+        c = FleetAuditor(LedgerDigest())
+        c.restore({})  # no persisted chain: fresh start stays fresh
+        assert c.chain == bytes(32)
+
+
+# --------------------------------------------------------- incident bundles
+
+
+class TestIncidentBundle:
+    _DUMPS = {
+        "nodes": {
+            "127.0.0.1:9101": {
+                "statusz": {"health": {"status": "ok"}, "stats": {"c": 1}},
+                "healthz": {"status": "ok"},
+                "tracez": {"traces": [{"seq": 1}]},
+                "debugz": {"snapshots": []},
+            },
+            "127.0.0.1:9102": {
+                "statusz": {"health": {"status": "degraded"}},
+                "healthz": {"status": "degraded"},
+                "capturez": {"cap": 8, "captured": 2, "records": []},
+            },
+        }
+    }
+
+    def test_bundle_is_byte_identical(self):
+        import copy
+
+        from at2_node_tpu.tools.incident import build_bundle
+
+        b1 = build_bundle(copy.deepcopy(self._DUMPS), reason="slo:breach")
+        b2 = build_bundle(copy.deepcopy(self._DUMPS), reason="slo:breach")
+        assert b1["files"] == b2["files"]
+        assert b1["manifest"] == b2["manifest"]
+        # every dump surface landed as a file, hashed in the manifest
+        assert set(b1["manifest"]["files"]) == set(b1["files"])
+        assert len(b1["files"]) == 7
+
+    def test_bundle_hash_tracks_content_and_reason_is_unhashed(self):
+        import copy
+
+        from at2_node_tpu.tools.incident import build_bundle
+
+        base = build_bundle(copy.deepcopy(self._DUMPS), reason="a")
+        mutated = copy.deepcopy(self._DUMPS)
+        mutated["nodes"]["127.0.0.1:9101"]["statusz"]["stats"]["c"] = 2
+        changed = build_bundle(mutated, reason="a")
+        assert (
+            changed["manifest"]["bundle_sha256"]
+            != base["manifest"]["bundle_sha256"]
+        )
+        # two collectors racing the same incident may name the trigger
+        # differently; the bundle hash covers the EVIDENCE, not the label
+        relabeled = build_bundle(copy.deepcopy(self._DUMPS), reason="b")
+        assert (
+            relabeled["manifest"]["bundle_sha256"]
+            == base["manifest"]["bundle_sha256"]
+        )
+
+    def test_write_bundle_matches_manifest(self, tmp_path):
+        import copy
+        import hashlib
+        import json as _json
+
+        from at2_node_tpu.tools.incident import build_bundle, write_bundle
+
+        bundle = build_bundle(copy.deepcopy(self._DUMPS))
+        manifest_path = write_bundle(str(tmp_path / "b"), bundle)
+        with open(manifest_path) as fp:
+            manifest = _json.load(fp)
+        assert manifest == bundle["manifest"]
+        for rel, digest in manifest["files"].items():
+            data = (tmp_path / "b" / rel).read_bytes()
+            assert hashlib.sha256(data).hexdigest() == digest
+
+    def test_edge_triggering(self):
+        from at2_node_tpu.tools.incident import _edges
+
+        ok = {
+            "nodes": {
+                "a:1": {
+                    "statusz": {
+                        "health": {"status": "ok"},
+                        "stats": {"recorder_snapshots": 2},
+                    }
+                }
+            }
+        }
+        bad = {
+            "nodes": {
+                "a:1": {
+                    "statusz": {
+                        "health": {
+                            "status": "diverged",
+                            "slo_breach": ["latency_p99"],
+                            "divergence": {"peer": "ff"},
+                        },
+                        "stats": {"recorder_snapshots": 3},
+                    }
+                }
+            }
+        }
+        assert _edges(None, bad) == []  # first poll is baseline only
+        assert _edges(ok, ok) == []
+        reasons = _edges(ok, bad)
+        assert any("health:diverged" in r for r in reasons)
+        assert any("slo:" in r for r in reasons)
+        assert any("divergence" in r for r in reasons)
+        assert any("anomaly_snapshot" in r for r in reasons)
+        # level-hold: staying degraded is NOT a fresh incident
+        assert _edges(bad, bad) == []
+
+
+# ------------------------------------------------------- wire-capture ring
+
+
+class TestWireCapture:
+    def _mesh(self, cap):
+        from at2_node_tpu.net.peers import Mesh
+
+        kp = ExchangeKeyPair.random()
+        return Mesh(
+            "127.0.0.1:0",
+            kp,
+            [],
+            on_frame=None,
+            capture_cap=cap,
+        )
+
+    def test_ring_bounded_and_cumulative(self):
+        mesh = self._mesh(4)
+        peer = Peer(
+            "127.0.0.1:1",
+            ExchangeKeyPair.random().public,
+            SignKeyPair.random().public,
+        )
+        for i in range(6):
+            mesh._capture_frame(peer, bytes([15, i]))
+        dump = mesh.capture_dump()
+        assert dump["cap"] == 4
+        assert dump["captured"] == 6  # cumulative, past the ring
+        assert len(dump["records"]) == 4  # ring keeps the newest
+        mono, peer_hex, kind, frame = dump["records"][-1]
+        assert peer_hex == peer.sign_public.hex()
+        assert kind == 15
+        assert frame == bytes([15, 5]).hex()
+        assert mesh.stats()["captured"] == 6
+
+    def test_kill_switch_cap_zero(self):
+        mesh = self._mesh(0)
+        assert mesh._capture is None  # hot path: one attribute check
+        assert mesh.stats()["captured"] == 0
+
+    def test_capture_to_events_normalizes_time(self):
+        from at2_node_tpu.tools.capture_replay import capture_to_events
+
+        doc = {
+            "records": [
+                [2_000_000_000, "aa", 1, "02"],
+                [1_000_000_000, "aa", 1, "01"],  # out of order on wire
+                [1_500_000_000, "aa", 1, "03"],
+            ]
+        }
+        events = capture_to_events(doc, target=2, speed=2.0, start=0.5)
+        # sorted by capture time, re-anchored to virtual start, spacing
+        # compressed by speed
+        assert [e[2]["frame"] for e in events] == ["01", "03", "02"]
+        assert [round(e[0], 3) for e in events] == [0.5, 0.75, 1.0]
+        assert all(e[1] == "inject" for e in events)
+        assert all(e[2]["target"] == 2 for e in events)
+        assert capture_to_events({"records": []}) == []
