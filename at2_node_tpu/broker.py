@@ -37,6 +37,8 @@ import grpc
 from .client import _target
 from .crypto.keys import SignKeyPair  # noqa: F401  (re-export for runners)
 from .net.webmux import PortMux
+from .node.config import OverloadConfig
+from .node.overload import broker_retry_after_ms, format_shed_details
 from .obs.recorder import FlightRecorder
 from .obs.registry import Registry
 from .obs.trace import TxTrace
@@ -76,6 +78,7 @@ class Broker(At2Servicer):
         clock=None,
         trace_sample: int = 1,
         recorder_cap: int = 2048,
+        overload: Optional[OverloadConfig] = None,
     ) -> None:
         from .clock import SYSTEM_CLOCK
 
@@ -86,6 +89,15 @@ class Broker(At2Servicer):
         self.node_uri = node_uri
         self.max_entries = max_entries
         self.window = window
+        # graduated brownout ([overload], node/overload.py): above
+        # brownout_frac of PENDING_CAP flush deadlines shrink (the eager
+        # machinery below), above refuse_frac new submissions are
+        # refused with a retry-after hint — the drop-at-cap cliff
+        # becomes a ladder. None/disabled keeps the historical behavior
+        # (hard cap only), though refusals are typed either way.
+        self.overload = overload if overload is not None and overload.enabled \
+            else None
+        self._retry_cfg = overload if overload is not None else OverloadConfig()
         # [wan] eager flush: anchor the flush deadline to the FIRST entry
         # of the pending batch instead of restarting a full window on
         # every delayed-flush cycle, and shrink it as the buffer fills —
@@ -114,10 +126,12 @@ class Broker(At2Servicer):
                 "broker_entries_tx",  # transfers forwarded inside frames
                 "broker_batches_tx",  # distilled frames forwarded
                 "broker_dedup_drops",  # (id, seq) dups dropped at build
-                "broker_overflow_drops",  # refused: buffer at PENDING_CAP
+                "broker_overflow_drops",  # hard-shed: buffer hit PENDING_CAP
+                "broker_refusals",  # refused BEFORE buffering (retryable)
                 "broker_forward_errors",  # SendDistilledBatch RPC failures
                 "broker_registrations",  # Register round-trips to the node
                 "broker_eager_flushes",  # flushes taken on the eager path
+                "broker_brownout_flushes",  # deadline-shrunk brownout flushes
             )
         )
         # seconds from flush trigger to frame handed to the RPC stack:
@@ -171,13 +185,14 @@ class Broker(At2Servicer):
         window: float = 0.005,
         eager: bool = False,
         clock=None,
+        overload: Optional[OverloadConfig] = None,
     ) -> "Broker":
         """Bring up a broker serving `at2.AT2` on ``listen`` (same
         PortMux surface as a node: native gRPC + grpc-web + GET
         /metrics), collecting for the node at ``node_uri``."""
         broker = Broker(
             node_uri, max_entries=max_entries, window=window, eager=eager,
-            clock=clock,
+            clock=clock, overload=overload,
         )
         try:
             server = grpc.aio.server()
@@ -240,14 +255,23 @@ class Broker(At2Servicer):
         "ok" freeze a flight-recorder snapshot, same edge-trigger
         contract as the node."""
         pending = len(self._buf)
+        ratio = pending / PENDING_CAP
         backpressure = pending >= int(PENDING_CAP * BACKPRESSURE_FRAC)
+        brownout = (
+            self.overload is not None and ratio >= self.overload.brownout_frac
+        )
         if self._closing:
             status = "closing"
         elif backpressure:
             status = "degraded"
+        elif brownout:
+            # deadline-shrinking/refusing but still serving: the
+            # "overloaded" grade is NOT a 503 — pulling a browning-out
+            # broker from rotation only concentrates the crowd
+            status = "overloaded"
         else:
             status = "ok"
-        ok = status == "ok"
+        ok = status in ("ok", "overloaded")
         if self._health_was_ok and not ok:
             self.recorder.snapshot(f"broker_degraded:{status}")
         self._health_was_ok = ok
@@ -258,8 +282,35 @@ class Broker(At2Servicer):
             "pending": pending,
             "pending_cap": PENDING_CAP,
             "backpressure": backpressure,
+            "pressure": round(ratio, 4),
+            "brownout": brownout,
             "flush_p99_ms": self.h_build.snapshot()["p99_ms"],
             "uptime_s": round(self.clock.monotonic() - self._started_at, 3),
+        }
+
+    def pressure_block(self) -> dict:
+        """The /statusz ``pressure`` block, broker flavor: the broker's
+        only pressure signal is its buffer-fill ratio, so the block is
+        the ladder position derived from it."""
+        ratio = len(self._buf) / PENDING_CAP
+        ov = self.overload
+        if self._closing:
+            level = "closing"
+        elif ratio >= 1.0:
+            level = "saturated"
+        elif ov is not None and ratio >= ov.refuse_frac:
+            level = "refusing"
+        elif ov is not None and ratio >= ov.brownout_frac:
+            level = "brownout"
+        else:
+            level = "normal"
+        return {
+            "enabled": ov is not None,
+            "pressure": round(ratio, 4),
+            "level": level,
+            "retry_after_ms": broker_retry_after_ms(self._retry_cfg, ratio),
+            "brownout_frac": self._retry_cfg.brownout_frac,
+            "refuse_frac": self._retry_cfg.refuse_frac,
         }
 
     def tracez(self, limit: int | None = None) -> dict:
@@ -279,13 +330,14 @@ class Broker(At2Servicer):
             return 200, self._OBS_PROM, self.registry.render_prometheus().encode()
         if route == "/healthz":
             verdict = self.health_verdict()
-            status = 200 if verdict["status"] == "ok" else 503
+            status = 200 if verdict["status"] in ("ok", "overloaded") else 503
             return status, self._OBS_JSON, json.dumps(verdict, sort_keys=True).encode()
         if route == "/statusz":
             body = json.dumps(
                 {
                     "role": "broker",
                     "health": self.health_verdict(),
+                    "pressure": self.pressure_block(),
                     "flush": self.h_build.snapshot(),
                     "stats": self.registry.snapshot(),
                 },
@@ -333,19 +385,49 @@ class Broker(At2Servicer):
             self.stats["broker_registrations"] += 1
         return cid
 
+    def _refuse_retry_ms(self) -> int:
+        return broker_retry_after_ms(
+            self._retry_cfg, len(self._buf) / PENDING_CAP
+        )
+
     async def _collect(self, requests, context) -> None:
         if self._closing:
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE, "broker shutting down"
             )
+        # graduated refusal ([overload]): above refuse_frac the broker
+        # turns submissions away with a typed retry-after BEFORE riding
+        # into the hard cap — refusals are retryable and cheap, cap hits
+        # mean work already interleaved past the ladder
+        if (
+            self.overload is not None
+            and len(self._buf) >= int(PENDING_CAP * self.overload.refuse_frac)
+        ):
+            self.stats["broker_refusals"] += len(requests)
+            retry_ms = self._refuse_retry_ms()
+            self.recorder.record(
+                "brownout_refuse", (len(requests), len(self._buf), retry_ms)
+            )
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                format_shed_details(
+                    "broker refusing under brownout", retry_ms
+                ),
+            )
         if len(self._buf) + len(requests) > PENDING_CAP:
-            self.stats["broker_overflow_drops"] += len(requests)
+            # refused before any buffering or register round-trips:
+            # retryable, counted apart from hard sheds
+            self.stats["broker_refusals"] += len(requests)
+            retry_ms = self._refuse_retry_ms()
             self.recorder.record(
                 "backpressure", (len(requests), len(self._buf))
             )
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
-                "broker buffer full; node unreachable or lagging",
+                format_shed_details(
+                    "broker buffer full; node unreachable or lagging",
+                    retry_ms,
+                ),
             )
         entries = []
         for i, req in enumerate(requests):
@@ -377,15 +459,21 @@ class Broker(At2Servicer):
         # re-check occupancy AFTER the awaits above: concurrent _collect
         # calls can each pass the entry check and then interleave at the
         # Register round-trips, so only a check with no await point
-        # between it and the extend actually enforces PENDING_CAP
+        # between it and the extend actually enforces PENDING_CAP. This
+        # is the hard-shed path — work was already performed for these
+        # entries — counted apart from the pre-buffer refusals above.
         if len(self._buf) + len(entries) > PENDING_CAP:
             self.stats["broker_overflow_drops"] += len(entries)
+            retry_ms = self._refuse_retry_ms()
             self.recorder.record(
                 "backpressure", (len(entries), len(self._buf))
             )
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
-                "broker buffer full; node unreachable or lagging",
+                format_shed_details(
+                    "broker buffer full; node unreachable or lagging",
+                    retry_ms,
+                ),
             )
         if not self._buf:
             # empty -> non-empty transition: this batch's age clock
@@ -406,20 +494,34 @@ class Broker(At2Servicer):
 
     async def _delayed_flush(self) -> None:
         while True:
-            if self.eager:
+            depth = len(self._buf)
+            # brownout ([overload]): a buffer past brownout_frac of the
+            # cap has nothing left to gain from batching patience —
+            # shrink the effective window toward zero as fill deepens,
+            # riding the same anchored-deadline machinery as eager
+            brownout = (
+                self.overload is not None
+                and depth >= int(PENDING_CAP * self.overload.brownout_frac)
+            )
+            shrink = (
+                max(0.05, 1.0 - depth / PENDING_CAP) if brownout else 1.0
+            )
+            if self.eager or brownout:
                 # queue-depth-adaptive deadline anchored to the batch's
                 # first entry: deep buffers flush sooner (less batching
                 # upside left), and time already spent buffered counts
                 # against the deadline instead of restarting it
-                depth = len(self._buf)
                 frac = max(
                     EAGER_MIN_FRAC, 1.0 - depth / self.max_entries
                 )
                 elapsed = self.clock.monotonic() - self._first_at
-                delay = frac * self.window - elapsed
+                delay = frac * self.window * shrink - elapsed
                 if delay > 0.0:
                     await self.clock.sleep(delay)
-                self.stats["broker_eager_flushes"] += 1
+                if self.eager:
+                    self.stats["broker_eager_flushes"] += 1
+                if brownout:
+                    self.stats["broker_brownout_flushes"] += 1
             else:
                 await self.clock.sleep(self.window)
             await self._flush()
@@ -494,8 +596,15 @@ class Broker(At2Servicer):
         return reply
 
     async def SendDistilledBatch(self, request, context):
-        """Pass-through: a pre-distilled frame needs no collection."""
-        return await self._stub.SendDistilledBatch(request)
+        """Pass-through: a pre-distilled frame needs no collection. A
+        node-side refusal (overload shed, RESOURCE_EXHAUSTED) re-aborts
+        with the SAME code and detail string, so the typed
+        ``retry_after_ms`` hint survives the hop instead of collapsing
+        into a generic INTERNAL error."""
+        try:
+            return await self._stub.SendDistilledBatch(request)
+        except grpc.aio.AioRpcError as exc:
+            await context.abort(exc.code(), exc.details() or "")
 
     async def GetBalance(self, request, context):
         return await self._stub.GetBalance(request)

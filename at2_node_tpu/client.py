@@ -12,12 +12,16 @@ RPC (`client.rs:61`, tonic `connect_lazy`).
 
 from __future__ import annotations
 
-from typing import List, Optional
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
 
 import grpc
 
 from .broadcast.messages import MAX_BATCH_ENTRIES as _RPC_BATCH_CAP
 from .crypto.keys import SignKeyPair
+from .node.overload import parse_retry_after_ms
 from .proto import at2_pb2 as pb
 from .proto.rpc import At2Stub
 from .types import (
@@ -26,6 +30,55 @@ from .types import (
     parse_rfc3339,
     transfer_signing_bytes,
 )
+
+
+@dataclass
+class RetryPolicy:
+    """Jittered-exponential retry budget for RESOURCE_EXHAUSTED refusals
+    (overload sheds, broker brownout — the [overload] ladder).
+
+    The backoff honors the server's typed ``retry_after_ms`` hint: the
+    delay is never shorter than the hint, so a shedding fleet paces its
+    own retry wave instead of the wave becoming a second flash crowd.
+    Jitter spreads synchronized clients over ``jitter`` of the delay
+    (full-window decorrelation is what keeps retries from re-bunching).
+    ``budget`` bounds attempts per logical call; once spent, the last
+    refusal propagates to the caller unchanged.
+
+    ``rng`` / ``sleep`` are injectable for deterministic tests."""
+
+    budget: int = 4
+    base_ms: float = 100.0
+    max_ms: float = 5000.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    rng: Callable[[], float] = field(default=random.random)
+    sleep: Callable[[float], "asyncio.Future"] = field(default=asyncio.sleep)
+
+    def delay_s(self, attempt: int, hint_ms: Optional[int] = None) -> float:
+        """Delay before retry number ``attempt`` (0-based), seconds."""
+        backoff = min(self.max_ms, self.base_ms * self.multiplier ** attempt)
+        if hint_ms is not None:
+            backoff = min(self.max_ms, max(backoff, float(hint_ms)))
+        spread = 1.0 - self.jitter / 2.0 + self.jitter * self.rng()
+        return backoff * spread / 1e3
+
+    async def run(self, attempt_fn):
+        """Run ``attempt_fn()`` with the retry budget. Retries only
+        RESOURCE_EXHAUSTED — anything else (bad signature, malformed
+        request) is not load-induced and must not be re-offered."""
+        attempt = 0
+        while True:
+            try:
+                return await attempt_fn()
+            except grpc.aio.AioRpcError as exc:
+                if exc.code() != grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    raise
+                if attempt >= self.budget:
+                    raise
+                hint = parse_retry_after_ms(exc.details())
+                await self.sleep(self.delay_s(attempt, hint))
+                attempt += 1
 
 
 def _target(uri: str) -> str:
@@ -38,9 +91,18 @@ def _target(uri: str) -> str:
 
 
 class Client:
-    def __init__(self, uri: str) -> None:
+    def __init__(self, uri: str, retry: Optional[RetryPolicy] = None) -> None:
         self._channel = grpc.aio.insecure_channel(_target(uri))
         self._stub = At2Stub(self._channel)
+        self._retry = retry
+
+    async def _submit(self, attempt_fn):
+        """Submission-path RPCs go through the retry budget when one is
+        configured; read-path RPCs never retry (a refused read is not
+        load the client should re-offer)."""
+        if self._retry is None:
+            return await attempt_fn()
+        return await self._retry.run(attempt_fn)
 
     async def close(self) -> None:
         await self._channel.close()
@@ -65,15 +127,14 @@ class Client:
         signature = keypair.sign(
             transfer_signing_bytes(keypair.public, sequence, recipient, amount)
         )
-        await self._stub.SendAsset(
-            pb.SendAssetRequest(
-                sender=keypair.public,
-                sequence=sequence,
-                recipient=recipient,
-                amount=amount,
-                signature=signature,
-            )
+        request = pb.SendAssetRequest(
+            sender=keypair.public,
+            sequence=sequence,
+            recipient=recipient,
+            amount=amount,
+            signature=signature,
         )
+        await self._submit(lambda: self._stub.SendAsset(request))
 
     async def send_asset_many(
         self,
@@ -103,11 +164,10 @@ class Client:
                 )
             )
         for lo in range(0, len(requests), _RPC_BATCH_CAP):
-            await self._stub.SendAssetBatch(
-                pb.SendAssetBatchRequest(
-                    transactions=requests[lo : lo + _RPC_BATCH_CAP]
-                )
+            chunk = pb.SendAssetBatchRequest(
+                transactions=requests[lo : lo + _RPC_BATCH_CAP]
             )
+            await self._submit(lambda: self._stub.SendAssetBatch(chunk))
 
     async def register(self, public_key: bytes) -> int:
         """Register a client pubkey into the node's gossiped directory
@@ -122,9 +182,8 @@ class Client:
         """Submit one distilled batch frame (proto/distill.py format) —
         the broker's forwarding path; also handy for tests driving the
         node's distilled ingress directly."""
-        await self._stub.SendDistilledBatch(
-            pb.SendDistilledBatchRequest(frame=frame)
-        )
+        request = pb.SendDistilledBatchRequest(frame=frame)
+        await self._submit(lambda: self._stub.SendDistilledBatch(request))
 
     async def get_balance(self, user: bytes) -> int:
         reply = await self._stub.GetBalance(pb.GetBalanceRequest(sender=user))

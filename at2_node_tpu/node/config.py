@@ -44,7 +44,12 @@ Two conscious additions over the reference schema:
   `fail_window` (see `AdmissionConfig`) — ingress pre-verification of
   client signatures at the RPC boundary plus a per-source rate limit on
   entries that FAIL it; `preverify = false` restores the previous
-  admit-then-verify-in-broadcast behavior exactly.
+  admit-then-verify-in-broadcast behavior exactly;
+* an optional `[overload]` table — closed-loop overload control (see
+  `OverloadConfig` and node/overload.py): a smoothed pressure score over
+  the live signals drives adaptive admission shedding and broker
+  brownout; `enabled = false` (the default) is fully inert and keeps
+  every same-seed wire schedule byte-identical.
 """
 
 from __future__ import annotations
@@ -435,6 +440,96 @@ class WanConfig:
 
 
 @dataclass
+class OverloadConfig:
+    """The `[overload]` table: closed-loop overload control (ISSUE 16).
+
+    ``enabled = false`` (the default) keeps the controller fully inert:
+    no samples are taken, no requests are shed, and the wire schedule —
+    and therefore every same-seed sim/campaign hash — is byte-identical
+    to a build without this table (hash-gated in CI, same bar as
+    `[wan]`).
+
+    When enabled, node/overload.py samples the live pressure signals
+    (verifier queue depth and sojourn, plane backlog, commit-tail age,
+    SLO fast-window burn) at most every ``sample_interval`` seconds,
+    folds the worst normalized signal into an EWMA pressure score
+    (``smoothing`` is the EWMA alpha), and sheds client ingress when
+    pressure crosses the ladder:
+
+    * ``sojourn_target_ms`` / ``sojourn_arm_s`` — CoDel-style gate on
+      the verifier queue-wait signal: sojourn must stay above target
+      for ``sojourn_arm_s`` continuous seconds before that signal
+      counts, and disarms once it falls below half the target, so a
+      single deep batch never triggers shedding.
+    * ``queue_target`` / ``backlog_target`` / ``tail_target_s`` —
+      full-scale normalization for verifier queue depth, undelivered
+      broadcast slots, and the oldest pending payload's age.
+    * ``shed_start`` .. ``shed_full`` — the shed ramp: unregistered
+      senders shed a fraction that rises linearly from 0 at
+      ``shed_start`` to 1.0 at ``shed_full``; senders already in the
+      gossiped client directory get ``registered_grace`` extra pressure
+      headroom before their ramp begins. Protocol traffic (echo/ready/
+      catchup/beacons) is never shed — it is what drains the backlog.
+    * ``retry_after_ms`` / ``retry_after_max_ms`` — the typed hint shed
+      responses carry (``retry_after_ms=N`` in the gRPC status detail),
+      scaled up with pressure and honored by client.py's RetryPolicy.
+    * ``brownout_frac`` / ``refuse_frac`` — the broker's graduated
+      ladder as fractions of PENDING_CAP: above ``brownout_frac`` the
+      broker shrinks its flush deadline (the eager-flush machinery),
+      above ``refuse_frac`` it refuses new submissions with the
+      retry-after hint instead of riding into the hard cap.
+    """
+
+    enabled: bool = False
+    sample_interval: float = 0.25
+    smoothing: float = 0.3
+    sojourn_target_ms: float = 150.0
+    sojourn_arm_s: float = 0.5
+    queue_target: int = 4096
+    backlog_target: int = 1024
+    tail_target_s: float = 5.0
+    shed_start: float = 0.5
+    shed_full: float = 0.95
+    registered_grace: float = 0.25
+    retry_after_ms: int = 250
+    retry_after_max_ms: int = 5000
+    brownout_frac: float = 0.5
+    refuse_frac: float = 0.9
+
+    def __post_init__(self) -> None:
+        if self.sample_interval <= 0:
+            raise ValueError("overload.sample_interval must be > 0")
+        if not 0.0 < self.smoothing <= 1.0:
+            raise ValueError("overload.smoothing must be in (0, 1]")
+        if self.sojourn_target_ms <= 0:
+            raise ValueError("overload.sojourn_target_ms must be > 0")
+        if self.sojourn_arm_s < 0:
+            raise ValueError("overload.sojourn_arm_s must be >= 0")
+        if self.queue_target < 1:
+            raise ValueError("overload.queue_target must be >= 1")
+        if self.backlog_target < 1:
+            raise ValueError("overload.backlog_target must be >= 1")
+        if self.tail_target_s <= 0:
+            raise ValueError("overload.tail_target_s must be > 0")
+        if not 0.0 < self.shed_start < self.shed_full:
+            raise ValueError(
+                "overload needs 0 < shed_start < shed_full"
+            )
+        if self.registered_grace < 0:
+            raise ValueError("overload.registered_grace must be >= 0")
+        if self.retry_after_ms < 1:
+            raise ValueError("overload.retry_after_ms must be >= 1")
+        if self.retry_after_max_ms < self.retry_after_ms:
+            raise ValueError(
+                "overload.retry_after_max_ms must be >= retry_after_ms"
+            )
+        if not 0.0 < self.brownout_frac < self.refuse_frac <= 1.0:
+            raise ValueError(
+                "overload needs 0 < brownout_frac < refuse_frac <= 1"
+            )
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -454,6 +549,7 @@ class Config:
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     plane: PlaneConfig = field(default_factory=PlaneConfig)
     wan: WanConfig = field(default_factory=WanConfig)
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -596,6 +692,27 @@ class Config:
                 f"verify_ahead = {'true' if wa.verify_ahead else 'false'}",
                 f"eager_broker = {'true' if wa.eager_broker else 'false'}",
             ]
+        ov = self.overload
+        if ov != OverloadConfig():
+            lines += [
+                "",
+                "[overload]",
+                f"enabled = {'true' if ov.enabled else 'false'}",
+                f"sample_interval = {ov.sample_interval}",
+                f"smoothing = {ov.smoothing}",
+                f"sojourn_target_ms = {ov.sojourn_target_ms}",
+                f"sojourn_arm_s = {ov.sojourn_arm_s}",
+                f"queue_target = {ov.queue_target}",
+                f"backlog_target = {ov.backlog_target}",
+                f"tail_target_s = {ov.tail_target_s}",
+                f"shed_start = {ov.shed_start}",
+                f"shed_full = {ov.shed_full}",
+                f"registered_grace = {ov.registered_grace}",
+                f"retry_after_ms = {ov.retry_after_ms}",
+                f"retry_after_max_ms = {ov.retry_after_max_ms}",
+                f"brownout_frac = {ov.brownout_frac}",
+                f"refuse_frac = {ov.refuse_frac}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -622,6 +739,7 @@ class Config:
         admission = AdmissionConfig(**doc.get("admission", {}))
         plane = PlaneConfig(**doc.get("plane", {}))
         wan = WanConfig(**doc.get("wan", {}))
+        overload = OverloadConfig(**doc.get("overload", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -647,6 +765,7 @@ class Config:
             admission=admission,
             plane=plane,
             wan=wan,
+            overload=overload,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

@@ -78,6 +78,7 @@ from ..store import RecoveryProgress, ShardedStore
 from .config import Config
 from .directory import ClientDirectory, DirectoryFullError
 from .membership import MembershipManager
+from .overload import OverloadController, format_shed_details
 
 logger = logging.getLogger(__name__)
 
@@ -263,6 +264,41 @@ class Service(At2Servicer):
         self.registry.gauge(
             "slo_samples", "probe samples held by the SLO engine",
             fn=lambda: self.slo.sample_count,
+        )
+        # per-objective fast-window burn as scrapeable gauges (the signal
+        # /sloz buried in JSON; also the overload controller's SLO input)
+        self.registry.register_provider(
+            "slo_burn_", lambda: self.slo.fast_burns()
+        )
+        # closed-loop overload controller (node/overload.py, config
+        # [overload]): constructed unconditionally so /statusz always
+        # carries a pressure block, fully inert while disabled — no
+        # samples, no sheds, byte-identical wire schedules
+        self.overload = OverloadController(
+            config.overload,
+            self.clock,
+            verifier_stats=self._verifier_stats,
+            stage_hists=self._overload_stage_hists,
+            backlog=self._plane_backlog,
+            tail_age=self._commit_tail_age,
+            burns=lambda: self.slo.fast_burns(),
+            on_transition=self._overload_transition,
+        )
+        self.overload_stats = self.registry.counter_group(
+            (
+                "overload_shed_requests",
+                "overload_shed_entries",
+                "overload_shed_distilled",
+            )
+        )
+        self.registry.gauge(
+            "overload_pressure", "smoothed overload pressure score",
+            fn=lambda: self.overload.pressure,
+        )
+        self.registry.gauge(
+            "overload_level",
+            "overload ladder position (0 normal .. 3 saturated)",
+            fn=lambda: float(self.overload.level),
         )
         # durable sharded store (store/sharded.py): None when [store] dir
         # is unset — the node then falls back to the legacy monolithic
@@ -1045,6 +1081,48 @@ class Service(At2Servicer):
                 out[name] = h
         return out
 
+    # -- overload-controller signal sources (node/overload.py) ----------
+
+    def _overload_stage_hists(self) -> Optional[dict]:
+        """Verifier stage snapshots for the controller's sojourn signal
+        — the TPU verifier's stage_histograms(), or a sim model's."""
+        if self.verifier is None:
+            return None
+        fn = getattr(self.verifier, "stage_histograms", None)
+        return fn() if callable(fn) else None
+
+    def _plane_backlog(self) -> float:
+        """Live undelivered broadcast slots, across shard cores when the
+        plane is sharded — the same number the ``slots_undelivered``
+        gauge exports."""
+        b = self.broadcast
+        if b is None:
+            return 0.0
+        und = getattr(b, "_undelivered", None)
+        if und is not None:
+            return float(und)
+        cores = getattr(b, "_cores", None)
+        if cores is not None:
+            return float(sum(c._undelivered for c in cores))
+        return 0.0
+
+    def _commit_tail_age(self) -> float:
+        """Age of the oldest payload parked in the commit retry heap —
+        the commit-tail-lag pressure signal."""
+        oldest = min((e[1] for e in self._heap), default=None)
+        if oldest is None:
+            return 0.0
+        return max(0.0, self.clock.monotonic() - oldest)
+
+    def _overload_transition(
+        self, old: str, new: str, pressure: float
+    ) -> None:
+        """Ladder transitions are flight-recorded so incident bundles
+        capture when and why the controller engaged."""
+        self.recorder.record(
+            "overload_level", (old, new, round(pressure, 4))
+        )
+
     def snapshot_stats(self) -> dict:
         """One structured stats record: broadcast per-stage counters +
         verifier batch metrics + commit progress (SURVEY.md §5). Now a
@@ -1091,6 +1169,11 @@ class Service(At2Servicer):
             await self.clock.sleep(interval)
             try:
                 self.slo_probe()
+                # piggyback the overload pressure sample: served nodes
+                # keep a fresh score even when ingress is idle (the sim
+                # has no probe loop — there the sample is taken lazily
+                # at ingress, keeping schedules deterministic)
+                self.overload.maybe_sample()
             except Exception:
                 logger.exception("slo probe failed")
 
@@ -1124,7 +1207,9 @@ class Service(At2Servicer):
             return 200, self._OBS_PROM, body
         if route == "/healthz":
             verdict = self.health_verdict()
-            status = 200 if verdict["status"] == "ok" else 503
+            # "overloaded" is still-serving by design: the controller is
+            # shedding excess ingress, not failing probes
+            status = 200 if verdict["status"] in ("ok", "overloaded") else 503
             body = json.dumps(verdict, sort_keys=True).encode()
             return status, self._OBS_JSON, body
         if route == "/statusz":
@@ -1342,10 +1427,18 @@ class Service(At2Servicer):
             status = "degraded"
         elif recovering:
             status = "recovering"
+        elif self.overload.overloaded:
+            # actively shedding but otherwise healthy: still serving,
+            # NOT a 503 — load balancers must keep routing here (pulling
+            # an overloaded node only concentrates the crowd on the
+            # rest); operators see the ladder on /statusz
+            status = "overloaded"
         else:
             status = "ok"
         return {
             "status": status,
+            "overload_level": self.overload.level,
+            "pressure": round(self.overload.pressure, 4),
             "recovering": recovering,
             "epoch": self.membership.epoch if self.membership else 0,
             "closing": self._closing,
@@ -1401,6 +1494,10 @@ class Service(At2Servicer):
             "verifier_stages": stages,
             "verifier_routing": routing,
             "slo": self.slo.evaluate(),
+            # overload-controller block (node/overload.py): the smoothed
+            # pressure score, ladder position, per-signal readings, and
+            # the live shed fractions / retry-after hint
+            "pressure": self.overload.snapshot(),
             "recovery": self.recovery.to_dict(self.clock.monotonic()),
             "membership": (
                 self.membership.stats() if self.membership else {}
@@ -2183,7 +2280,9 @@ class Service(At2Servicer):
         """The source's token bucket ``[tokens, stamp]`` in ``buckets``,
         refilled continuously to ``limit`` over ``window`` seconds. All
         buckets in one dict share (limit, window) — the eviction scan
-        below depends on that."""
+        below depends on that. Refill is clamped at zero elapsed time:
+        a clock stepping backwards (NTP slew, a test's fake clock) must
+        neither mint tokens nor DRAIN them via a negative delta."""
         rate = limit / window
         bucket = buckets.get(source)
         if bucket is None:
@@ -2194,7 +2293,7 @@ class Service(At2Servicer):
                 full = [
                     k
                     for k, (t, s) in buckets.items()
-                    if t + (now - s) * rate >= limit
+                    if t + max(0.0, now - s) * rate >= limit
                 ]
                 for k in full:
                     del buckets[k]
@@ -2203,8 +2302,11 @@ class Service(At2Servicer):
             bucket = [float(limit), now]
             buckets[source] = bucket
         else:
-            bucket[0] = min(float(limit), bucket[0] + (now - bucket[1]) * rate)
-            bucket[1] = now
+            elapsed = max(0.0, now - bucket[1])
+            bucket[0] = min(float(limit), bucket[0] + elapsed * rate)
+            # the stamp never moves backwards: re-crediting an interval
+            # the bucket already refilled over would mint free tokens
+            bucket[1] = max(bucket[1], now)
         return bucket
 
     def _admission_refill(self, source: str, now: float) -> list:
@@ -2230,7 +2332,14 @@ class Service(At2Servicer):
         rejected HERE — they never reach the gossip plane, so one
         poisoned entry can no longer stall a whole broadcast slot. The
         per-source bucket is charged only for FAILED entries; a source
-        that exhausted it is refused before any verifier work."""
+        that exhausted it is refused before any verifier work.
+
+        Overload shedding (node/overload.py, config [overload]) happens
+        FIRST: a shed request costs no verifier work and must NOT charge
+        the sender's fail bucket — refusing valid work under pressure is
+        the node's state, not evidence against the sender. Shed
+        responses carry a typed ``retry_after_ms`` hint."""
+        await self._overload_gate(payloads, context)
         ad = self.config.admission
         if not ad.preverify or self.verifier is None:
             return
@@ -2268,6 +2377,43 @@ class Service(At2Servicer):
             grpc.StatusCode.INVALID_ARGUMENT,
             "client signature verification failed"
             + (f" (entries {bad})" if len(payloads) > 1 else ""),
+        )
+
+    async def _overload_gate(self, payloads: List[Payload], context) -> None:
+        """The adaptive-admission actuator: one deterministic shed
+        decision per client request, taken before any verifier work.
+        Senders already in the gossiped directory get the configured
+        grace (the crowd is, almost by definition, unknown senders).
+        Protocol traffic never passes through here — only client
+        ingress is sheddable."""
+        ov = self.overload
+        if not ov.cfg.enabled:
+            return
+        now = self.clock.monotonic()
+        # lazy sample: the sim has no standing probe loop, so ingress is
+        # where the pressure score stays fresh (rate-limited inside)
+        ov.maybe_sample(now)
+        registered = all(
+            self.directory.id_of(p.sender) is not None for p in payloads
+        )
+        retry_ms = ov.admit(registered=registered, now=now)
+        if retry_ms is None:
+            return
+        self.overload_stats["overload_shed_requests"] += 1
+        self.overload_stats["overload_shed_entries"] += len(payloads)
+        self._trace_stamp(payloads, REJECTED)
+        self.recorder.record(
+            "overload_shed",
+            (
+                len(payloads),
+                "registered" if registered else "new",
+                round(ov.pressure, 4),
+                retry_ms,
+            ),
+        )
+        await context.abort(
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            format_shed_details("ingress shed under overload", retry_ms),
         )
 
     def _trace_begin(self, payloads: List[Payload]) -> None:
@@ -2473,7 +2619,12 @@ class Service(At2Servicer):
         E = distill.ENTRY_WIRE
         ad = self.config.admission
         preverify = ad.preverify and self.verifier is not None
+        ov = self.overload
+        ov_on = ov.cfg.enabled
+        if ov_on:
+            ov.maybe_sample(now)
         n_dedup = 0
+        n_shed = 0
         kept: List[int] = []
         keys: List[Tuple[int, int]] = []
         for i, cid in enumerate(ids):
@@ -2484,6 +2635,15 @@ class Service(At2Servicer):
             if k in seen:
                 self.distill_stats["dedup_drops"] += 1
                 n_dedup += 1
+                continue
+            # overload shedding is per-entry here (the frame is a
+            # many-sender aggregate; all-or-nothing would punish every
+            # broker client for pressure one caused). Distilled entries
+            # are directory-resolved by construction, so they shed on
+            # the registered (graced) ramp — and a shed must NOT charge
+            # the cid's fail bucket, so it runs before the refill.
+            if ov_on and ov.admit(registered=True, now=now) is not None:
+                n_shed += 1
                 continue
             if preverify:
                 bucket = self._admission_refill(f"cid:{cid}", now)
@@ -2496,6 +2656,24 @@ class Service(At2Servicer):
             # aggregated per frame (not per entry): a replaying broker
             # must not be able to flood the ring via its own dups
             self.recorder.record("dedup_drop", (n_dedup, len(ok)))
+        if n_shed:
+            self.overload_stats["overload_shed_distilled"] += n_shed
+            self.recorder.record(
+                "overload_shed_distilled",
+                (n_shed, len(ok), round(ov.pressure, 4)),
+            )
+            if not kept:
+                # the whole frame was shed: surface typed backpressure
+                # to the broker instead of a silent empty ACK, so its
+                # forwarding loop (and its clients' retry budgets) can
+                # back off on the hint
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    format_shed_details(
+                        "distilled ingress shed under overload",
+                        ov.retry_after_ms(),
+                    ),
+                )
         if preverify and kept:
             # the v2 transfer preimage is TAG + the first 76 body bytes
             # (sender || seq || recipient || amount — types.py), so a

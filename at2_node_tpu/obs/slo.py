@@ -295,6 +295,26 @@ class SloEngine:
     def breaching(self, now: Optional[float] = None) -> List[str]:
         return self.evaluate(now)["breaching"]
 
+    def fast_burns(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Per-objective burn over the FAST window only, as a flat
+        ``{objective_name: burn}`` dict — the scrapeable form of the
+        signal /sloz buries in JSON. This is both a /metrics gauge
+        provider (Service registers it under ``slo_burn_``) and the
+        overload controller's SLO input. Idle/no-data objectives read
+        as 0.0 burn: no evidence is not pressure."""
+        if now is None:
+            now = self._clock.monotonic()
+        fast = self.windows[0]
+        cutoff = now - fast
+        samples = [s for s in self._samples if s["t"] >= cutoff]
+        out: Dict[str, float] = {}
+        for obj in self.objectives:
+            w = _eval_window(obj, samples, fast)
+            out[obj.name] = w["burn"] if w["status"] in (
+                "ok", "breaching"
+            ) else 0.0
+        return out
+
 
 def evaluate_point(objectives: List[Objective], measures: dict) -> dict:
     """Offline single-point evaluation for banked artifacts: apply the

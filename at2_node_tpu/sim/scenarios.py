@@ -23,6 +23,7 @@ slice and replays one cell to assert the hash reproduces.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import random
 import time
@@ -152,25 +153,35 @@ def steady_workload(
 
 def flash_crowd_workload(
     rng: random.Random, *, nodes: int, n_clients: int, n_tx: int,
-    duration: float,
+    duration: float, crowd: Optional[int] = None,
 ) -> List[Event]:
     """A burst riding on baseline traffic: half the volume arrives in a
     window one-tenth of the run (a ~10× instantaneous rate spike) —
     the viral-moment shape that exposes queueing and quorum-stall
-    behavior a steady offered rate never does."""
+    behavior a steady offered rate never does.
+
+    ``crowd`` splits the sender population the way real flash crowds
+    look: the LAST ``crowd`` client indices originate only the burst
+    (newcomers, ~1 tx each at crowd ≈ n_tx//2) while the first
+    ``n_clients - crowd`` carry the baseline — the shape the overload
+    cells shed against. The split changes only which client index each
+    triple carries, never the rng draw sequence, so ``crowd=None``
+    (the grid default) is byte-identical to the historical generator at
+    any client count (the scaled 10k–100k populations included)."""
     n_burst = n_tx // 2
     n_base = n_tx - n_burst
     burst_at = duration * 0.45
     burst_len = duration * 0.10
+    base_pool = n_clients if crowd is None else max(1, n_clients - crowd)
     raw = [
-        (rng.uniform(0.0, duration), rng.randrange(nodes), i % n_clients)
+        (rng.uniform(0.0, duration), rng.randrange(nodes), i % base_pool)
         for i in range(n_base)
     ]
     raw += [
         (
             burst_at + rng.uniform(0.0, burst_len),
             rng.randrange(nodes),
-            i % n_clients,
+            i % n_clients if crowd is None else base_pool + (i % crowd),
         )
         for i in range(n_burst)
     ]
@@ -185,7 +196,10 @@ def hot_account_workload(
     a sender's transfers serialize through its sequence gate, the hot
     account's tail latency grows with its pipeline depth while everyone
     else stays cheap — the fairness index and the p99/p50 gap are the
-    signals this shape exists to produce."""
+    signals this shape exists to produce. Scales to any population
+    (the overload cells run it at thousands of clients): the hot share
+    stays ~40% regardless of ``n_clients``, so skew does not dilute as
+    the population grows."""
     raw = []
     for i in range(n_tx):
         client = 0 if rng.random() < 0.4 else 1 + rng.randrange(n_clients - 1)
@@ -275,6 +289,7 @@ def run_cell(
     capture_trace: bool = False,
     wan: bool = False,
     plane_shards: int = 1,
+    overload=None,
 ) -> dict:
     """One grid cell: fresh SimNet with the topology's link matrix, the
     workload's schedule plus the fault mix, run + settle, then measure
@@ -285,7 +300,10 @@ def run_cell(
     quorum phases, region-aware fanout, verify-ahead) — the overlap
     levers the WAN_GRID cells exist to measure. ``capture_trace``
     attaches the full stitched timeline (big; the grid driver keeps it
-    off for banked cells and on for --inspect)."""
+    off for banked cells and on for --inspect). ``overload`` installs
+    an [overload] table (node/config.OverloadConfig) on every node; a
+    default (disabled) instance leaves the wire trace byte-identical to
+    ``overload=None`` — the off-identity the overload CI gate asserts."""
     from ..tools.trace_collect import _pctl, stitch  # lazy: tools→sim
     # is the import direction elsewhere; avoid the cycle
 
@@ -298,6 +316,8 @@ def run_cell(
         overrides["wan"] = WanConfig(
             overlap_ready=True, region_fanout=True, verify_ahead=True
         )
+    if overload is not None:
+        overrides["overload"] = overload
     net = SimNet(nodes, f, seed, hostile=0, link=_INTRA, **overrides)
     apply_topology(net, topology)
     net.start()
@@ -460,6 +480,391 @@ def run_grid(
     }
 
 
+# -- overload A/B cells ----------------------------------------------------
+#
+# The default grid has no load→latency coupling: the sim charges virtual
+# time for link latency and batching windows but verification is
+# instantaneous, so a 10× flash crowd cannot build the queue the
+# [overload] controller exists to sense. The overload cells close that
+# gap with a capacity model on the fleet's SHARED verifier (the TPU-pool
+# semantics the real deployment has): every verify_many call FIFO-queues
+# behind one modeled device and charges n/sigs_per_sec of virtual time.
+# Admission sheds happen before preverify, so shed work consumes zero
+# modeled capacity — exactly the feedback loop being measured.
+
+
+class ModeledVerifier:
+    """Sim-only finite-capacity wrapper around the net's shared verifier.
+
+    FIFO service through one asyncio.Lock (lock wakeups are FIFO and the
+    sim scheduler is deterministic, so arrival order fully determines
+    service order); each ``verify_many`` charges ``n / sigs_per_sec``
+    virtual seconds. Exposes the surfaces the OverloadController samples:
+    ``stats()["queue_depth"]`` (signatures waiting or in service) and
+    ``stage_histograms()["queue_wait"]`` (cumulative per-call wait, the
+    count/sum_ms pair the sojourn signal differences). Everything else
+    delegates to the wrapped verifier — verdicts stay real."""
+
+    def __init__(self, inner, clock, sigs_per_sec: float) -> None:
+        self._inner = inner
+        self._clock = clock
+        self._rate = float(sigs_per_sec)
+        self._lock = asyncio.Lock()
+        self._depth = 0
+        self._qw_count = 0
+        self._qw_sum_ms = 0.0
+        self.total_sigs = 0
+
+    async def verify_many(self, items):
+        n = len(items)
+        self._depth += n
+        self.total_sigs += n
+        t0 = self._clock.monotonic()
+        async with self._lock:
+            self._qw_count += 1
+            self._qw_sum_ms += (self._clock.monotonic() - t0) * 1e3
+            await self._clock.sleep(n / self._rate)
+            self._depth -= n
+        return await self._inner.verify_many(items)
+
+    def stats(self) -> dict:
+        fn = getattr(self._inner, "stats", None)
+        base = dict(fn()) if callable(fn) else {}
+        base["queue_depth"] = self._depth
+        base["modeled_sigs_per_sec"] = self._rate
+        base["modeled_total_sigs"] = self.total_sigs
+        return base
+
+    def stage_histograms(self) -> dict:
+        return {
+            "queue_wait": {
+                "count": self._qw_count,
+                "sum_ms": round(self._qw_sum_ms, 3),
+            }
+        }
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def overload_objectives(capacity_sigs_per_sec: float):
+    """Tuned [overload] knobs for the A/B cells' controlled arm: the
+    queue target is half a second of modeled capacity (queueing beyond
+    that is latency the SLO can see), sampling is tightened so the
+    controller reacts within a burst's first tenth, and the smoothing
+    is raised to track a spike that lasts ~1 virtual second."""
+    from ..node.config import OverloadConfig
+
+    return OverloadConfig(
+        enabled=True,
+        sample_interval=0.1,
+        smoothing=0.5,
+        queue_target=max(8, int(capacity_sigs_per_sec * 0.1)),
+        sojourn_target_ms=250.0,
+        sojourn_arm_s=0.3,
+        shed_start=0.5,
+        shed_full=0.9,
+        registered_grace=0.3,
+        # crowd hold-offs long enough to smear a burst's retry waves
+        # over the drain's headroom; registered sheds ignore the max
+        # and come back at the base (see retry_after_ms in overload.py)
+        retry_after_ms=250,
+        retry_after_max_ms=3000,
+    )
+
+
+#: measured fleet-wide verification cost of one committed transfer on a
+#: 4-node net (admission preverify + every node's echo/ready attestation
+#: checks) — used only to size the modeled pool relative to offered load
+_OVERLOAD_SIGS_PER_TX = 33.0
+
+#: per-workload A/B tuning: the modeled pool as a fraction of the cell's
+#: average offered signature rate (<1 ⇒ the cell is overcommitted and
+#: the uncontrolled arm MUST queue), and the steady-tier latency SLO.
+#: hot_account runs its steady tier near saturation by design, so its
+#: SLO is laxer — same reasoning as the grid's per-workload ceilings.
+_OVERLOAD_WORKLOADS = {
+    "flash_crowd": {"capacity_frac": 0.90, "latency_slo_ms": 2500.0},
+    "hot_account": {"capacity_frac": 0.68, "latency_slo_ms": 4500.0},
+}
+
+
+def run_overload_cell(
+    seed: int,
+    workload: str = "flash_crowd",
+    *,
+    controlled: bool,
+    nodes: int = 4,
+    f: int = 1,
+    n_clients: int = 60,
+    crowd: int = 40,
+    n_tx: int = 80,
+    duration: float = 12.0,
+    capacity_sigs_per_sec: float = 200.0,
+    settle_horizon: float = 300.0,
+    latency_slo_ms: float = 2500.0,
+    fairness_floor: float = 0.8,
+    retry_budget: int = 4,
+    overload=None,
+) -> dict:
+    """One overload A/B arm: scaled workload against a finite modeled
+    verifier, measured on the STEADY tier (the clients the fleet knew
+    before the event — registered into the directory pre-burst). Both
+    arms run the identical offered schedule (same derived rng, same sim
+    seed); only the [overload] table differs, so any delta is the
+    controller's doing. ``controlled=False`` runs with the table off —
+    the collapse baseline the bench banks alongside the controlled arm.
+
+    Every client retries RESOURCE_EXHAUSTED sheds up to
+    ``retry_budget`` times with deterministic jittered exponential
+    backoff honoring the server's ``retry_after_ms`` hint — the sim
+    analog of client.py's RetryPolicy, so a shed is pacing, not loss.
+    Latency is CLIENT-perceived: from the tx's originally offered time
+    to its last node's commit, retry hold-offs included.
+
+    For ``flash_crowd`` the crowd is the last ``crowd`` client indices
+    (never registered, ~1 tx each); for ``hot_account`` the hot sender
+    (client 0) plays the newcomer and everyone else is steady."""
+    import grpc
+
+    from ..node.config import ObservabilityConfig
+    from ..node.overload import parse_retry_after_ms
+    from ..tools.trace_collect import _pctl
+
+    wall0 = time.monotonic()
+    # one schedule for BOTH arms: the arm must not feed the derivation
+    rng = random.Random(
+        _seed_int("overload", seed, workload, n_clients, crowd, n_tx)
+    )
+    if workload == "flash_crowd":
+        steady_ids = list(range(max(1, n_clients - crowd)))
+    elif workload == "hot_account":
+        steady_ids = list(range(1, n_clients))
+    else:
+        raise ValueError(f"no overload variant for workload {workload!r}")
+
+    cap = max(4096, 4 * n_tx)
+    overrides: dict = {
+        "observability": ObservabilityConfig(
+            trace_cap=cap, trace_done_cap=cap, recorder_cap=cap
+        )
+    }
+    if controlled:
+        ov = overload or overload_objectives(capacity_sigs_per_sec)
+        overrides["overload"] = ov
+    net = SimNet(nodes, f, seed, hostile=0, link=_INTRA, **overrides)
+    net.verifier = ModeledVerifier(
+        net.verifier, net.clock, capacity_sigs_per_sec
+    )
+    net.start()
+    try:
+        clients = [sim_client(seed, i) for i in range(n_clients)]
+
+        async def _register_steady():
+            for i in steady_ids:
+                await net.aregister(i % nodes, clients[i].public)
+
+        net.loop.run_until_complete(_register_steady())
+        net.run_for(2.0)  # let DirectoryAnnounce gossip reach every node
+
+        if workload == "flash_crowd":
+            events = flash_crowd_workload(
+                rng, nodes=nodes, n_clients=n_clients, n_tx=n_tx,
+                duration=duration, crowd=crowd,
+            )
+        else:
+            events = hot_account_workload(
+                rng, nodes=nodes, n_clients=n_clients, n_tx=n_tx,
+                duration=duration,
+            )
+        offered_by_client = [0] * n_clients
+        for _t, _k, args in events:
+            offered_by_client[args["client"]] += 1
+
+        # submission driver with the client-side retry budget: shed
+        # responses are retried after the server's hint, scaled by a
+        # hash-derived deterministic jitter (no rng draws — draw order
+        # under concurrent tasks would couple the schedule to scheduler
+        # internals) and an exponential per-attempt factor. Anything
+        # other than RESOURCE_EXHAUSTED is terminal.
+        t_base = net.clock.monotonic()
+        offered_mono: Dict[tuple, float] = {}
+
+        async def _one(ev) -> None:
+            t, _kind, a = ev
+            ci, seq = a["client"], a["seq"]
+            offered_mono[(clients[ci].public.hex(), seq)] = t_base + t
+            await net.clock.sleep(
+                max(0.0, t_base + t - net.clock.monotonic())
+            )
+            to = clients[a["to"]].public
+            for attempt in range(retry_budget + 1):
+                err = await net.asubmit(
+                    a["node"], clients[ci], seq, to, a["amount"]
+                )
+                if err is None:
+                    return
+                if err.code != grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    return
+                if attempt >= retry_budget:
+                    return
+                hint = parse_retry_after_ms(err.details)
+                base_s = (hint if hint is not None else 250) / 1e3
+                jitter = (
+                    (ci * 2654435761 + seq * 40503 + attempt * 97) % 1024
+                ) / 1024.0
+                await net.clock.sleep(
+                    min(8.0, base_s * (2.0 ** attempt) * (0.5 + jitter))
+                )
+
+        async def _drive() -> None:
+            await asyncio.gather(*(
+                asyncio.ensure_future(_one(ev)) for ev in events
+            ))
+
+        net.loop.run_until_complete(_drive())
+        last_t = max((e[0] for e in events), default=0.0)
+        settle_t = net.settle(horizon=settle_horizon)
+        violations = net.check_invariants()
+
+        # client-perceived commit latency: offered time -> the LAST
+        # node's committed stamp (fleet commit), straight from the
+        # per-node trace rings — retry hold-offs included, which the
+        # stitched per-attempt view would hide
+        commit_mono: Dict[tuple, float] = {}
+        for s in net.services:
+            dump = s.tracez()
+            for rec in list(dump.get("completed", ())) + list(
+                dump.get("live", ())
+            ):
+                for st, m, _w in rec["stages"]:
+                    if st == "committed":
+                        k = (rec["sender"], rec["seq"])
+                        commit_mono[k] = max(commit_mono.get(k, 0.0), m)
+        steady_pubs = {clients[i].public.hex() for i in steady_ids}
+        steady_lats: List[float] = []
+        all_lats: List[float] = []
+        for k, m in commit_mono.items():
+            t0 = offered_mono.get(k)
+            if t0 is None:
+                continue
+            lat = m - t0
+            all_lats.append(lat)
+            if k[0] in steady_pubs:
+                steady_lats.append(lat)
+        steady_lats.sort()
+        all_lats.sort()
+
+        frontier = net.services[0].accounts.frontier_nowait()
+        ratios = [
+            float(frontier.get(clients[i].public, 0)) / offered_by_client[i]
+            for i in steady_ids
+            if offered_by_client[i] > 0
+        ]
+        fairness = round(jain_index(ratios), 6)
+        shed = sum(
+            s.overload_stats["overload_shed_entries"]
+            + s.overload_stats["overload_shed_distilled"]
+            for s in net.services
+        )
+        shed_events = sum(
+            1
+            for s in net.services
+            for ev in s.recorder.dump()["events"]
+            if ev[1] in ("overload_shed", "overload_shed_distilled")
+        )
+        steady_p99 = round(1e3 * _pctl(steady_lats, 0.99), 3)
+        slo_ok = bool(steady_lats) and steady_p99 <= latency_slo_ms
+        fairness_ok = fairness >= fairness_floor
+        return {
+            "workload": workload,
+            "arm": "controlled" if controlled else "uncontrolled",
+            "seed": seed,
+            "nodes": nodes,
+            "f": f,
+            "n_clients": n_clients,
+            "crowd": crowd if workload == "flash_crowd" else 1,
+            "capacity_sigs_per_sec": capacity_sigs_per_sec,
+            "modeled_sigs": net.verifier.total_sigs,
+            "offered": sum(offered_by_client),
+            "offered_steady": sum(offered_by_client[i] for i in steady_ids),
+            "committed": min(s.committed for s in net.services),
+            "committed_steady": len(steady_lats),
+            "shed": shed,
+            "shed_events": shed_events,
+            "steady_p50_ms": round(1e3 * _pctl(steady_lats, 0.50), 3),
+            "steady_p99_ms": steady_p99,
+            "all_p99_ms": round(1e3 * _pctl(all_lats, 0.99), 3),
+            "fairness": fairness,
+            "latency_slo_ms": latency_slo_ms,
+            "fairness_floor": fairness_floor,
+            "slo_ok": slo_ok,
+            "fairness_ok": fairness_ok,
+            "virtual_time": round(last_t + 1.0 + 2.0 + settle_t, 3),
+            "wall_seconds": round(time.monotonic() - wall0, 3),
+            "trace_hash": net.fabric.trace_hash(),
+            "violations": violations,
+        }
+    finally:
+        net.close()
+
+
+def run_overload_ab(
+    seed: int,
+    *,
+    workloads=("flash_crowd", "hot_account"),
+    n_clients: int = 120,
+    crowd: int = 80,
+    n_tx: int = 160,
+    duration: float = 12.0,
+    progress=None,
+    **cell_kw,
+) -> dict:
+    """The BENCH_OVERLOAD.json document: each workload run uncontrolled
+    then controlled against the same schedule, folded into one A/B hash
+    (the determinism fingerprint the overload CI gate compares across
+    same-seed runs). The bench's claim is the pair: the uncontrolled
+    arm must breach the steady-tier latency SLO and the controlled arm
+    must hold it while keeping fairness above the floor. The modeled
+    pool is sized per workload relative to the cell's offered load
+    (_OVERLOAD_WORKLOADS), so the A/B dynamics are scale-invariant —
+    growing ``n_clients``/``n_tx`` grows the capacity with them."""
+    offered_sig_rate = _OVERLOAD_SIGS_PER_TX * n_tx / duration
+    cells: List[dict] = []
+    for w in workloads:
+        tune = _OVERLOAD_WORKLOADS[w]
+        for controlled in (False, True):
+            cell = run_overload_cell(
+                seed, w, controlled=controlled,
+                n_clients=n_clients, crowd=crowd, n_tx=n_tx,
+                duration=duration,
+                capacity_sigs_per_sec=round(
+                    tune["capacity_frac"] * offered_sig_rate, 3
+                ),
+                latency_slo_ms=tune["latency_slo_ms"],
+                **cell_kw,
+            )
+            cells.append(cell)
+            if progress is not None:
+                progress(cell)
+    h = hashlib.sha256()
+    for cell in cells:
+        h.update(cell["trace_hash"].encode())
+    ok = all(
+        (c["slo_ok"] and c["fairness_ok"])
+        if c["arm"] == "controlled"
+        else not c["slo_ok"]
+        for c in cells
+    ) and not any(c["violations"] for c in cells)
+    return {
+        "bench": "overload_ab",
+        "seed": seed,
+        "cells": cells,
+        "ab_hash": h.hexdigest(),
+        "ok": bool(ok),
+    }
+
+
 __all__ = [
     "FAULT_MIXES",
     "GRID",
@@ -467,10 +872,14 @@ __all__ = [
     "TOPOLOGIES",
     "WAN_GRID",
     "WORKLOADS",
+    "ModeledVerifier",
     "apply_topology",
     "cell_objectives",
     "fault_events",
     "jain_index",
+    "overload_objectives",
     "run_cell",
     "run_grid",
+    "run_overload_ab",
+    "run_overload_cell",
 ]

@@ -19,6 +19,7 @@ import logging
 import sys
 
 from ..broker import Broker
+from ..node.config import OverloadConfig
 from ..proto.distill import DISTILL_MAX_ENTRIES
 
 
@@ -29,6 +30,9 @@ async def _run(args) -> int:
         max_entries=args.max_entries,
         window=args.window,
         eager=args.eager,
+        overload=(
+            OverloadConfig(enabled=True) if args.overload else None
+        ),
     )
     try:
         await broker.serve_forever()
@@ -54,6 +58,11 @@ def main(argv=None) -> int:
                     help="anchor the flush deadline to the first buffered "
                     "entry and shrink it as the buffer fills (lower "
                     "tail latency, smaller frames)")
+    ap.add_argument("--overload", action="store_true",
+                    help="graduated brownout ladder (default [overload] "
+                    "knobs): shrink flush deadlines past brownout_frac "
+                    "of the pending cap, refuse with a retry-after hint "
+                    "past refuse_frac, instead of the hard-cap cliff")
     ap.add_argument("--log-level", default="warning")
     args = ap.parse_args(argv)
     logging.basicConfig(level=args.log_level.upper())

@@ -24,7 +24,7 @@ import time
 from dataclasses import dataclass
 from typing import List
 
-from ..client import Client
+from ..client import Client, RetryPolicy
 from ..crypto.keys import SignKeyPair
 
 
@@ -43,15 +43,19 @@ class LoadResult:
 
 
 async def _client_worker(
-    uri: str, keypair: SignKeyPair, n_tx: int, window: int, rpc_batch: int = 1
+    uri: str, keypair: SignKeyPair, n_tx: int, window: int, rpc_batch: int = 1,
+    retry_budget: int = 0,
 ) -> int:
     """Issue n_tx self-transfers with sequences 1..n_tx, keeping up to
     ``window`` requests in flight (a firehose, not a lockstep loop).
     ``rpc_batch`` > 1 ships them ``rpc_batch`` per SendAssetBatch call
-    (the beyond-parity bulk ingress) instead of one per SendAsset."""
+    (the beyond-parity bulk ingress) instead of one per SendAsset.
+    ``retry_budget`` > 0 arms the client's jittered retry policy for
+    RESOURCE_EXHAUSTED sheds (the server's [overload] ladder)."""
     sent = 0
     window = max(window, 1)
-    async with Client(uri) as client:
+    retry = RetryPolicy(budget=retry_budget) if retry_budget > 0 else None
+    async with Client(uri, retry=retry) as client:
         pending: set = set()
 
         async def _drain_one():
@@ -121,6 +125,7 @@ async def run_load(
     commit_timeout: float = 120.0,
     rpc_batch: int = 1,
     broker: bool = False,
+    retry_budget: int = 0,
 ) -> LoadResult:
     keypairs = [SignKeyPair.random() for _ in range(clients)]
     if broker:
@@ -143,7 +148,8 @@ async def run_load(
     sent = await asyncio.gather(
         *(
             _client_worker(
-                rpcs[i % len(rpcs)], kp, tx_per_client, window, rpc_batch
+                rpcs[i % len(rpcs)], kp, tx_per_client, window, rpc_batch,
+                retry_budget,
             )
             for i, kp in enumerate(keypairs)
         )
@@ -181,6 +187,10 @@ def main(argv=None) -> int:
                     "(tools/broker.py): pre-register every client into "
                     "the directory, then fire the same load — the broker "
                     "distills it into SendDistilledBatch frames")
+    ap.add_argument("--retry-budget", type=int, default=0,
+                    help="retries per call for RESOURCE_EXHAUSTED sheds "
+                    "(jittered exponential backoff honoring the server's "
+                    "retry_after_ms hint; 0 = fail fast)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -193,6 +203,7 @@ def main(argv=None) -> int:
             commit_timeout=args.commit_timeout,
             rpc_batch=args.rpc_batch,
             broker=args.broker,
+            retry_budget=args.retry_budget,
         )
     )
     if args.json:

@@ -50,6 +50,7 @@ _SINGLE_FILES = (
     "BENCH_SCENARIOS.json",
     "BENCH_OBS_OVERHEAD.json",
     "BENCH_PLANE_SHARDS.json",
+    "BENCH_OVERLOAD.json",
 )
 
 
@@ -421,6 +422,63 @@ def load_obs_overhead(name: str, doc: dict) -> List[dict]:
     ]
 
 
+def load_overload(name: str, doc: dict) -> List[dict]:
+    """BENCH_OVERLOAD.json: the overload-control A/B bench. Series are
+    named by (workload, arm) so the uncontrolled collapse baseline and
+    the controlled arm track separately — a controlled-arm p99 drifting
+    UP toward its SLO is a regression even while still "passing", and
+    the uncontrolled arm is informational (its p99 falling would mean
+    the bench no longer stresses the fleet; comparability pins the
+    offered scale so that shows up as a band breach too). Fairness is
+    higher-better; shed counts are labels, not judged series."""
+    cells = _require(doc, "cells", name, list)
+    _require(doc, "ab_hash", name, str)
+    _require(doc, "ok", name)
+    rows: List[dict] = []
+    for i, cell in enumerate(cells):
+        path = f"{name}.cells[{i}]"
+        cname = (
+            f"{_require(cell, 'workload', path, str)}"
+            f".{_require(cell, 'arm', path, str)}"
+        )
+        _require(cell, "trace_hash", path, str)
+        comp = (
+            f"clients={cell.get('n_clients')} crowd={cell.get('crowd')} "
+            f"offered={cell.get('offered')} "
+            f"capacity={cell.get('capacity_sigs_per_sec')}"
+        )
+        rows.append(
+            _row(
+                f"overload/{cname}.steady_p99_ms",
+                "current",
+                0,
+                _num(cell, "steady_p99_ms", path),
+                comp,
+                lower_better=True,
+            )
+        )
+        rows.append(
+            _row(
+                f"overload/{cname}.fairness",
+                "current",
+                0,
+                _num(cell, "fairness", path),
+                comp,
+            )
+        )
+        if cell["arm"] == "controlled":
+            rows.append(
+                _row(
+                    f"overload/{cname}.committed_steady",
+                    "current",
+                    0,
+                    _num(cell, "committed_steady", path),
+                    comp,
+                )
+            )
+    return rows
+
+
 _SINGLE_LOADERS = {
     "BENCH_LASTGOOD.json": load_lastgood,
     "BENCH_AGGREGATE.json": load_aggregate,
@@ -430,6 +488,7 @@ _SINGLE_LOADERS = {
     "BENCH_SCENARIOS.json": load_scenarios,
     "BENCH_OBS_OVERHEAD.json": load_obs_overhead,
     "BENCH_PLANE_SHARDS.json": load_plane_shards,
+    "BENCH_OVERLOAD.json": load_overload,
 }
 
 _RUN_LOADERS = {

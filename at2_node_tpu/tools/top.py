@@ -2,7 +2,8 @@
 
 The operator view for every load run (ISSUE 3): tx/s (committed delta
 between refreshes), ingress→commit latency percentiles, verifier
-occupancy and queue-wait, broadcast slot backlog, and per-node health —
+occupancy and queue-wait, broadcast slot backlog, [overload] pressure
+and shed rate, and per-node health —
 straight from the observability endpoints the mux serves, no RPC stubs
 and no dependencies beyond the stdlib.
 
@@ -56,6 +57,45 @@ def _num(snapshot: dict, key: str, default=0):
     return v if isinstance(v, (int, float)) else default
 
 
+def _shed_basis(sz: dict) -> int:
+    """Cumulative shed count backing the ``shed/s`` rate column:
+    [overload] admission sheds (unary entries + distilled entries) for
+    nodes, pre-buffer brownout/backpressure refusals for brokers. All
+    zero while the [overload] table is off."""
+    stats = sz.get("stats", {})
+    if sz.get("role") == "broker":
+        return _num(stats, "broker_refusals")
+    return _num(stats, "overload_shed_entries") + _num(
+        stats, "overload_shed_distilled"
+    )
+
+
+def _pressure_cell(sz: dict) -> str:
+    """The ``press`` column: smoothed pressure score from the /statusz
+    ``pressure`` block (buffer-fill ratio for brokers). "-" for nodes
+    predating the block or with [overload] absent."""
+    block = sz.get("pressure")
+    if not isinstance(block, dict):
+        return "-"
+    p = block.get("pressure")
+    if not isinstance(p, (int, float)):
+        return "-"
+    cell = f"{p:.2f}"
+    level = block.get("level")
+    if isinstance(level, str) and level not in ("normal", "off"):
+        cell += "!"
+    return cell
+
+
+def _shed_rate(addr: str, sz: dict, now: float, prev) -> str:
+    """shed/s delta against the previous frame; blank on the first
+    frame (or against a pre-column 2-tuple basis)."""
+    seen = prev.get(addr)
+    if seen is None or len(seen) < 3 or now <= seen[0]:
+        return ""
+    return f"{(_shed_basis(sz) - seen[2]) / (now - seen[0]):.1f}"
+
+
 def _recovery_cell(recovery: dict) -> str:
     """Compact progress for the ``recovery`` column: the live stage plus
     the one counter that says how far along it is."""
@@ -81,7 +121,8 @@ def render_frame(rows, now: float, prev) -> str:
         f"{'p50 ms':>9}{'p99 ms':>9}{'dlv p99':>9}{'live tr':>9}"
         f"{'rej':>6}{'vrf occ':>9}{'vmode':>10}{'q-wait p99':>12}"
         f"{'lag p99':>9}"
-        f"{'backlog':>9}{'dstl rx/ms/dd':>15}{'peers':>7}"
+        f"{'backlog':>9}{'press':>7}{'shed/s':>8}"
+        f"{'dstl rx/ms/dd':>15}{'peers':>7}"
         f"{'shards':>8}{'epoch':>7}  {'recovery':<16}"
     )
     lines = []
@@ -144,6 +185,8 @@ def render_frame(rows, now: float, prev) -> str:
                 f"{'-':>12}"
                 f"{'-':>9}"
                 f"{pend:>9}"
+                f"{_pressure_cell(sz):>7}"
+                f"{_shed_rate(addr, sz, now, prev):>8}"
                 f"{drops:>15}"
                 f"{_num(stats, 'broker_registrations'):>7}"
                 f"{'-':>8}"
@@ -216,6 +259,8 @@ def render_frame(rows, now: float, prev) -> str:
             f"{qw_s:>12}"
             f"{lag_s:>9}"
             f"{_num(stats, 'slots_undelivered'):>9}"
+            f"{_pressure_cell(sz):>7}"
+            f"{_shed_rate(addr, sz, now, prev):>8}"
             f"{dstl_s:>15}"
             f"{_num(health, 'peers_connected'):>4}/"
             f"{_num(health, 'peers_configured'):<2}"
@@ -298,7 +343,10 @@ def once_verdict(rows, recovery_deadline: float,
             bad.append(f"{addr} (down)")
             continue
         status = sz.get("health", {}).get("status")
-        if status == "ok":
+        # "overloaded" is load shedding doing its job, not a fault: the
+        # node answers, commits, and will grade back to ok when pressure
+        # drains — failing the gate on it would page on every flash crowd
+        if status in ("ok", "overloaded"):
             if lag_deadline is not None:
                 lag = sz.get("stats", {}).get("event_loop_lag_p99_ms")
                 if isinstance(lag, (int, float)) and lag > lag_deadline:
@@ -391,7 +439,7 @@ async def run(addrs, interval: float, once: bool, clear: bool,
                     if sz.get("role") == "broker"
                     else _num(sz.get("health", {}), "committed")
                 )
-                prev[addr] = (now, basis)
+                prev[addr] = (now, basis, _shed_basis(sz))
         if once:
             # scripting/CI contract: nonzero when ANY polled node is
             # unreachable or self-reports degraded health — a fleet

@@ -242,6 +242,47 @@ assert p99 < 1000.0, f"sub-second WAN finality missed: p99 {p99} ms"
 print(f"wan3 steady +wan: p99 {p99} ms < 1000 ms, SLO ok")
 EOF
 
+echo "== overload-control gate =="
+# Closed-loop overload control (ISSUE 16), three contracts:
+#  1. the A/B claim at smoke scale on the scaled flash crowd, against a
+#     finite modeled verifier pool: [overload] off must BREACH the
+#     steady-tier client-perceived p99 SLO (the collapse baseline),
+#     [overload] on must HOLD it while keeping Jain fairness for the
+#     steady (pre-registered) senders at 1.0 >= the 0.8 floor — the
+#     tool exits nonzero unless both arms hold their side;
+#  2. determinism: the same seed must reproduce the same ab_hash
+#     (sha256 over per-cell wire-trace hashes), byte-identical;
+#  3. off-identity: a config carrying an all-defaults (disabled)
+#     [overload] table must produce a wire trace byte-identical to one
+#     with no table at all — same bar as the [wan] knobs.
+overload_ab() {
+  python -m at2_node_tpu.tools.overload_ab --seed 5 --clients 60 \
+    --crowd 40 --txs 80 --workload flash_crowd --quiet
+}
+v1="$(overload_ab)" || { echo "overload A/B claim FAILED: $v1" >&2; exit 1; }
+v2="$(overload_ab)" || { echo "overload A/B claim FAILED: $v2" >&2; exit 1; }
+vh1="$(printf '%s' "$v1" | sed -n 's/.*hash \([0-9a-f]*\).*/\1/p')"
+vh2="$(printf '%s' "$v2" | sed -n 's/.*hash \([0-9a-f]*\).*/\1/p')"
+if [ -z "$vh1" ] || [ "$vh1" != "$vh2" ]; then
+  echo "overload-control gate FAILED: ab_hash '$vh1' != '$vh2'" >&2
+  exit 1
+fi
+echo "same-seed overload A/B hash reproduced: $vh1"
+python - <<'EOF'
+from at2_node_tpu.node.config import OverloadConfig
+from at2_node_tpu.sim.scenarios import run_cell
+
+kw = dict(n_tx=24, duration=8.0)
+plain = run_cell(7, "lan", "steady", "none", **kw)
+tabled = run_cell(7, "lan", "steady", "none", overload=OverloadConfig(), **kw)
+assert plain["trace_hash"] == tabled["trace_hash"], (
+    f"[overload]-off not byte-identical: {plain['trace_hash'][:12]} != "
+    f"{tabled['trace_hash'][:12]}"
+)
+print("all-knobs-off [overload] table is wire-invisible:",
+      plain["trace_hash"][:16])
+EOF
+
 echo "== fleet-audit gate =="
 # Fleet consistency auditor + capture/replay bridge (ISSUE 15), three
 # contracts:

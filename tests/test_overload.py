@@ -1,0 +1,363 @@
+"""[overload] closed-loop control unit tests (ISSUE 16).
+
+Pure-function coverage of the pieces the flash-crowd A/B bench
+(tools/overload_ab.py) exercises end-to-end: admission token buckets
+under clock skew, the OverloadController's deterministic ladder (ramp
+math, debt accumulators, CoDel arming, fast-attack/slow-release EWMA,
+strict registered-tier priority), typed retry_after_ms hints, and the
+client RetryPolicy math with an injected rng — plus one sim-backed test
+that a shed is typed RESOURCE_EXHAUSTED and charges NOTHING to the
+sender's signature fail bucket."""
+
+import grpc
+import pytest
+
+from at2_node_tpu.client import RetryPolicy
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.node.config import OverloadConfig
+from at2_node_tpu.node.overload import (
+    LEVELS,
+    OverloadController,
+    broker_retry_after_ms,
+    format_shed_details,
+    parse_retry_after_ms,
+)
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.sim.net import SimNet, SimRpcError
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def monotonic(self) -> float:
+        return self.t
+
+
+def _cfg(**kw) -> OverloadConfig:
+    """Enabled config with a small, test-legible ladder: ramp over
+    [0.5, 0.9], instant EWMA, zero-rate-limit sampling."""
+    base = dict(
+        enabled=True,
+        sample_interval=1e-9,
+        smoothing=1.0,
+        queue_target=10,
+        sojourn_target_ms=100.0,
+        sojourn_arm_s=1.0,
+        shed_start=0.5,
+        shed_full=0.9,
+        registered_grace=0.2,
+        retry_after_ms=100,
+        retry_after_max_ms=1000,
+    )
+    base.update(kw)
+    return OverloadConfig(**base)
+
+
+class TestBucketClockSkew:
+    """Service._bucket_refill: the shared token-bucket primitive behind
+    the [admission] fail and register buckets. limit=4 over window=4s
+    (rate 1 token/s) throughout."""
+
+    def _refill(self, buckets, now, limit=4.0, window=4.0):
+        return Service._bucket_refill(buckets, "src", now, limit, window)
+
+    def test_burst_at_window_edge_never_exceeds_limit(self):
+        b = {}
+        bucket = self._refill(b, 0.0)
+        assert bucket[0] == 4.0  # fresh bucket starts full
+        bucket[0] = 0.0  # fully drained by failures at t=0
+        # continuous refill: 3s elapsed -> 3 tokens, not a cliff at the
+        # window edge
+        assert self._refill(b, 3.0)[0] == pytest.approx(3.0)
+        # an arbitrarily long gap caps at the limit — crossing the
+        # window boundary mints at most one window's worth, ever
+        assert self._refill(b, 400.0)[0] == 4.0
+
+    def test_refill_after_idle_resumes_from_the_spend(self):
+        b = {}
+        self._refill(b, 0.0)
+        # long idle (bucket pinned at the cap), then a spend
+        bucket = self._refill(b, 100.0)
+        bucket[0] = 1.0
+        # refill resumes at the configured rate from the spend point
+        assert self._refill(b, 101.5)[0] == pytest.approx(2.5)
+
+    def test_backwards_clock_neither_mints_nor_drains(self):
+        b = {}
+        bucket = self._refill(b, 100.0)
+        bucket[0] = 1.0
+        # clock steps back 50s (NTP slew): a negative delta must not
+        # drain tokens, and the stamp must hold — re-crediting the
+        # interval the bucket already refilled over would mint tokens
+        back = self._refill(b, 50.0)
+        assert back[0] == pytest.approx(1.0)
+        assert back[1] == 100.0
+        # once the clock catches back up, refill credits only the time
+        # past the held stamp: 2 real seconds -> 2 tokens, not 52
+        assert self._refill(b, 102.0)[0] == pytest.approx(3.0)
+
+
+class TestControllerLadder:
+    def _ctl(self, cfg=None, depth=0, **kw):
+        box = {"queue_depth": depth}
+        ctl = OverloadController(
+            cfg or _cfg(),
+            FakeClock(),
+            verifier_stats=lambda: box,
+            **kw,
+        )
+        ctl._depth_box = box  # test handle, not API
+        return ctl
+
+    def test_shed_fraction_linear_ramp(self):
+        ctl = self._ctl()
+        ctl._signals = {"occupancy": 2.0}
+        for p, want in ((0.4, 0.0), (0.5, 0.0), (0.7, 0.5), (0.9, 1.0),
+                        (2.0, 1.0)):
+            ctl.pressure = p
+            assert ctl.shed_fraction(registered=False) == pytest.approx(want)
+
+    def test_registered_grace_shifts_the_ramp(self):
+        ctl = self._ctl()
+        # queue past target and growing: the registered exemption is off
+        ctl._signals = {"occupancy": 1.5}
+        ctl.draining = False
+        ctl.pressure = 0.8  # grace 0.2: registered ramp starts at 0.7
+        assert ctl.shed_fraction(registered=False) == pytest.approx(0.75)
+        assert ctl.shed_fraction(registered=True) == pytest.approx(0.25)
+
+    def test_registered_exempt_unless_queue_growing_past_target(self):
+        ctl = self._ctl()
+        ctl.pressure = 2.0  # saturated
+        # sub-target queue: the fleet absorbs registered marginal load
+        ctl._signals = {"occupancy": 0.9}
+        ctl.draining = False
+        assert ctl.shed_fraction(registered=True) == 0.0
+        # draining queue: saturation is the ghost of the crowd's burst
+        ctl._signals = {"occupancy": 2.0}
+        ctl.draining = True
+        assert ctl.shed_fraction(registered=True) == 0.0
+        # growing AND past target: now the registered ramp engages
+        ctl.draining = False
+        assert ctl.shed_fraction(registered=True) == 1.0
+        # the crowd ramp never had the exemption
+        ctl.draining = True
+        assert ctl.shed_fraction(registered=False) == 1.0
+
+    def test_debt_accumulator_is_exact_and_deterministic(self):
+        # depth 6 / target 10 -> raw 0.6 every sample; smoothing 1.0
+        # pins pressure at 0.6 -> new-tier shed fraction 0.25
+        a = self._ctl(depth=6)
+        b = self._ctl(depth=6)
+        da = [a.admit(registered=False, now=float(i)) for i in range(100)]
+        db = [b.admit(registered=False, now=float(i)) for i in range(100)]
+        assert da == db  # no RNG anywhere in the decision
+        shed = [i for i, r in enumerate(da) if r is not None]
+        # the long-run rate is exact up to fp rounding of the fraction
+        # ((0.6-0.5)/0.4 lands a hair under 0.25), and the cadence is
+        # perfectly periodic — one shed every 4 decisions
+        assert len(shed) == 24
+        assert {b - a for a, b in zip(shed, shed[1:])} == {4}
+
+    def test_retry_after_scales_with_pressure_and_clamps(self):
+        ctl = self._ctl()
+        ctl.pressure = 0.5
+        assert ctl.retry_after_ms() == 100  # at the ramp start: base
+        ctl.pressure = 0.9
+        # 100 * (1 + 4 * 0.4) = 260
+        assert ctl.retry_after_ms() == 260
+        ctl.pressure = 2.0
+        # 100 * (1 + 4 * 1.5) = 700, still under the 1000 cap
+        assert ctl.retry_after_ms() == 700
+        ctl.cfg.retry_after_max_ms = 500
+        assert ctl.retry_after_ms() == 500  # clamped
+
+    def test_registered_hint_stays_flat(self):
+        # a registered shed is a transient growth-window event: the
+        # sender should come right back, not queue behind the crowd's
+        # pressure-scaled hold-offs
+        ctl = self._ctl()
+        ctl.pressure = 2.0
+        assert ctl.retry_after_ms(registered=True) == 100
+
+    def test_broker_hint_same_ladder_shape(self):
+        cfg = _cfg()
+        assert broker_retry_after_ms(cfg, 0.0) == 100
+        assert broker_retry_after_ms(cfg, 0.5) == 300
+        assert broker_retry_after_ms(cfg, 1.0) == 500
+        assert broker_retry_after_ms(cfg, 5.0) == 500  # ratio clamped
+
+    def test_disabled_controller_is_inert(self):
+        ctl = self._ctl(cfg=_cfg(enabled=False), depth=1000)
+        for i in range(50):
+            assert ctl.admit(registered=False, now=float(i)) is None
+        ctl.maybe_sample(99.0)
+        assert ctl.samples == 0
+        assert ctl.pressure == 0.0
+        assert not ctl.overloaded
+
+    def test_maybe_sample_rate_limit(self):
+        ctl = self._ctl(cfg=_cfg(sample_interval=1.0))
+        ctl.maybe_sample(0.0)
+        ctl.maybe_sample(0.5)
+        assert ctl.samples == 1
+        ctl.maybe_sample(1.0)
+        assert ctl.samples == 2
+
+    def test_level_transitions_fire_callback(self):
+        seen = []
+        ctl = self._ctl(
+            on_transition=lambda old, new, p: seen.append((old, new))
+        )
+        for depth, level in ((0, 0), (4, 1), (6, 2), (10, 3), (10, 3)):
+            ctl._depth_box["queue_depth"] = depth
+            ctl.sample(float(len(seen)))
+            assert LEVELS[ctl.level] == LEVELS[level]
+        assert seen == [
+            ("normal", "elevated"),
+            ("elevated", "shedding"),
+            ("shedding", "saturated"),
+        ]
+        assert ctl.overloaded  # level >= shedding
+
+    def test_fast_attack_slow_release(self):
+        ctl = self._ctl(cfg=_cfg(smoothing=0.5), depth=20)  # occupancy 2.0
+        ctl.sample(0.0)
+        assert ctl.pressure == pytest.approx(1.0)  # attack at full alpha
+        ctl.sample(1.0)
+        assert ctl.pressure == pytest.approx(1.5)
+        # load vanishes: release runs at a quarter of the attack rate,
+        # so one quiet tick cannot re-open admission
+        ctl._depth_box["queue_depth"] = 0
+        ctl.sample(2.0)
+        assert ctl.pressure == pytest.approx(1.5 * (1 - 0.5 * 0.25))
+        assert ctl.draining
+
+    def test_codel_arming_and_empty_queue_disarm(self):
+        hist = {"count": 0.0, "sum_ms": 0.0}
+        depth = {"queue_depth": 5}
+        ctl = OverloadController(
+            _cfg(),
+            FakeClock(),
+            verifier_stats=lambda: depth,
+            stage_hists=lambda: {"queue_wait": dict(hist)},
+        )
+        ctl.sample(0.0)  # primes the histogram snapshot
+        assert not ctl.armed
+        # sustained 500ms sojourn (target 100): over, but not yet armed
+        hist.update(count=10.0, sum_ms=5000.0)
+        ctl.sample(0.5)
+        assert not ctl.armed
+        assert ctl._signals["sojourn"] == 0.0  # unarmed signal is muted
+        # still over after sojourn_arm_s of continuous breach: armed
+        hist.update(count=20.0, sum_ms=10000.0)
+        ctl.sample(1.6)
+        assert ctl.armed
+        assert ctl._signals["sojourn"] == 2.0  # 500/100 capped at 2.0
+        # queue fully drained, no completions: the stale high reading
+        # must not hold the signal armed forever
+        depth["queue_depth"] = 0
+        ctl.sample(2.0)
+        assert not ctl.armed
+        assert ctl._signals["sojourn"] == 0.0
+
+    def test_standing_queue_keeps_last_reading(self):
+        hist = {"count": 10.0, "sum_ms": 5000.0}
+        depth = {"queue_depth": 5}
+        ctl = OverloadController(
+            _cfg(sojourn_arm_s=0.0),
+            FakeClock(),
+            verifier_stats=lambda: depth,
+            stage_hists=lambda: {"queue_wait": dict(hist)},
+        )
+        ctl.sample(0.0)
+        hist.update(count=20.0, sum_ms=10000.0)
+        ctl.sample(1.0)
+        assert ctl.armed
+        # no completions but work still queued: no fresh evidence either
+        # way — the armed reading holds
+        ctl.sample(2.0)
+        assert ctl.armed
+        assert ctl._signals["sojourn"] == 2.0
+
+
+class TestTypedHints:
+    def test_format_parse_round_trip(self):
+        details = format_shed_details("ingress shed under overload", 260)
+        assert details.endswith("retry_after_ms=260")
+        assert parse_retry_after_ms(details) == 260
+
+    def test_parse_tolerates_hintless_details(self):
+        assert parse_retry_after_ms(None) is None
+        assert parse_retry_after_ms("") is None
+        assert parse_retry_after_ms("too many invalid signatures") is None
+
+
+class TestRetryPolicy:
+    def test_delay_math_with_injected_rng(self):
+        p = RetryPolicy(budget=4, base_ms=100.0, max_ms=5000.0,
+                        multiplier=2.0, jitter=0.5, rng=lambda: 0.5)
+        # rng 0.5 makes the jitter spread exactly 1.0
+        assert p.delay_s(0) == pytest.approx(0.1)
+        assert p.delay_s(2) == pytest.approx(0.4)
+        assert p.delay_s(10) == pytest.approx(5.0)  # capped at max_ms
+
+    def test_server_hint_raises_the_floor(self):
+        p = RetryPolicy(jitter=0.5, rng=lambda: 0.5)
+        assert p.delay_s(0, hint_ms=1000) == pytest.approx(1.0)
+        # the hint is a floor, not a ceiling: a longer computed backoff
+        # stands
+        assert p.delay_s(6, hint_ms=1000) >= 1.0
+
+    def test_jitter_spread_bounds(self):
+        lo = RetryPolicy(base_ms=100.0, jitter=0.5, rng=lambda: 0.0)
+        hi = RetryPolicy(base_ms=100.0, jitter=0.5, rng=lambda: 1.0)
+        assert lo.delay_s(0) == pytest.approx(0.075)
+        assert hi.delay_s(0) == pytest.approx(0.125)
+
+
+class TestShedChargesNothing:
+    """Sim-backed: a shed aborts RESOURCE_EXHAUSTED with a parseable
+    hint, counts in overload_stats, and never charges the sender's
+    [admission] fail bucket — refusing valid work under pressure is the
+    node's state, not evidence against the sender."""
+
+    def test_shed_typed_and_fail_bucket_untouched(self):
+        net = SimNet(
+            2,
+            0,
+            seed=5,
+            overload=_cfg(sample_interval=1000.0),
+        )
+        try:
+            net.start()
+            svc = net.services[0]
+            ov = svc.overload
+            # force saturation and freeze the sampler (the huge
+            # sample_interval keeps maybe_sample from overwriting it)
+            ov.pressure = 2.0
+            ov._signals = {"occupancy": 2.0}
+            ov.draining = False
+            ov._last_sample = net.clock.monotonic()
+            kp = SignKeyPair.random()
+            err = net.submit(0, kp, 1, b"r" * 32, 1)
+            assert isinstance(err, SimRpcError)
+            assert err.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+            hint = parse_retry_after_ms(err.details)
+            assert hint is not None and hint >= ov.cfg.retry_after_ms
+            assert svc.overload_stats["overload_shed_requests"] == 1
+            assert svc.overload_stats["overload_shed_entries"] == 1
+            # the shed aborted BEFORE admission: no bucket was created,
+            # no signature rejection was recorded
+            assert svc._admission_buckets == {}
+            snap = svc.snapshot_stats()
+            assert snap["rejected_at_ingress"] == 0
+            assert snap["admission_throttled"] == 0
+            # pressure drains: the very same sender is admitted — a shed
+            # left no throttling state behind
+            ov.pressure = 0.0
+            ov._signals = {}
+            assert net.submit(0, kp, 1, b"r" * 32, 1) is None
+        finally:
+            net.close()
