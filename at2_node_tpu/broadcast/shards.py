@@ -41,12 +41,24 @@ bounded SPSC queues and applied by the owner loop after each dispatch.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import os
+import struct
 from typing import Dict, List, Optional
 
+from ..parallel import plane_worker as pw
 from ..parallel.plane import SPSCQueue, make_plane_executor
+from ..parallel.plane_worker import STAT_KEYS, WorkerSpec
 from .messages import (
     BATCH,
+    BATCH_ECHO,
+    BATCH_READY,
+    BATCH_REQ,
+    ECHO,
+    GOSSIP,
+    READY,
+    REQUEST,
     Attestation,
     BatchAttestation,
     BatchContentRequest,
@@ -61,6 +73,20 @@ from .stack import (
     STALL_KICK_MIN_INTERVAL,
     WORKER_CHUNK,
     Broadcast,
+)
+
+# wire kinds whose state lives on a shard core (everything else is
+# control plane, dispatched on the owner through core 0's handlers)
+_SLOT_KINDS = frozenset(
+    (GOSSIP, ECHO, READY, REQUEST, BATCH, BATCH_ECHO, BATCH_READY, BATCH_REQ)
+)
+_SLOT_TYPES = (
+    Payload,
+    Attestation,
+    TxBatch,
+    BatchAttestation,
+    ContentRequest,
+    BatchContentRequest,
 )
 
 logger = logging.getLogger(__name__)
@@ -147,6 +173,8 @@ class ShardedPlane:
         clock=None,
         phases=None,
         overlap_ready: bool = False,
+        ring_slots: int = 4096,
+        ring_slot_bytes: int = 1024,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
         from ..obs.registry import Registry
@@ -163,12 +191,26 @@ class ShardedPlane:
         self.trace = trace
         self.recorder = recorder
         self.phases = phases
+        self._overlap_ready = overlap_ready
         self.delivered: asyncio.Queue = asyncio.Queue()
         self._inbox: asyncio.Queue = asyncio.Queue(maxsize=65536)
         self._inbox_bytes = 0
         self._tasks: list = []
-        self._executor = make_plane_executor(executor, shards)
+        self._executor = make_plane_executor(
+            executor, shards,
+            ring_slots=ring_slots, ring_slot_bytes=ring_slot_bytes,
+        )
         self._inline = self._executor.name == "inline"
+        self._proc = self._executor.name == "process"
+        # process-mode owner-side state: merged watermarks, per-shard
+        # gauge snapshots, and the crash ledger /healthz attributes
+        self._proc_wm_tx: Dict[bytes, int] = {}
+        self._proc_wm_batch: Dict[bytes, int] = {}
+        self._proc_undeliv = [0] * shards
+        self._proc_floor_refusals = [0] * shards
+        self.worker_crashed: Dict[int, int] = {}
+        self.on_worker_crash = None  # service hook: (shard_id, exitcode)
+        self._pending_wm_restore: list = []
 
         # one effects lane per shard (only drained in threaded mode, but
         # constructed always so instruments exist and stay cheap)
@@ -206,18 +248,26 @@ class ShardedPlane:
             "stall_kicks_suppressed",
         ))
 
+        # Process mode still builds the owner-side cores, but they stay
+        # EMPTY forever: the real shard state lives in the worker
+        # processes (parallel/plane_worker.py). What the owner cores
+        # provide is the control-plane dispatch seam (core 0's _pre_msg
+        # runs the catchup/directory/config/beacon handlers), the
+        # threshold/floor bookkeeping the spec factory reads, and an
+        # unchanged surface for every cross-shard accessor below.
+        owner_side = self._inline or self._proc
         self._cores: List[Broadcast] = []
         for sid in range(shards):
             core = Broadcast(
                 keypair,
-                mesh if self._inline else _ShardMesh(mesh, self._effects[sid]),
+                mesh if owner_side else _ShardMesh(mesh, self._effects[sid]),
                 verifier,  # unused by cores (owner runs the bulk verify)
                 echo_threshold=echo_threshold,
                 ready_threshold=ready_threshold,
                 workers=0,
                 registry=None,  # private registry; shared stats below
                 trace=trace if self._inline else None,
-                recorder=recorder if self._inline else None,
+                recorder=recorder if owner_side else None,
                 clock=self.clock,
                 phases=(
                     phases.shard_view(sid, self.registry)
@@ -227,7 +277,7 @@ class ShardedPlane:
                 overlap_ready=overlap_ready,
             )
             core.stats = self.stats  # ONE aggregate counter group
-            if self._inline:
+            if owner_side:
                 core.delivered = self.delivered
                 core.stall_handler = self._fire_stall
             else:
@@ -248,7 +298,10 @@ class ShardedPlane:
 
         self.registry.gauge(
             "slots_undelivered", "live undelivered broadcast slots",
-            fn=lambda: sum(c._undelivered for c in self._cores),
+            fn=lambda: (
+                sum(c._undelivered for c in self._cores)
+                + sum(self._proc_undeliv)
+            ),
         )
         self.registry.gauge(
             "inbox_depth", "raw frames queued for the broadcast workers",
@@ -260,8 +313,18 @@ class ShardedPlane:
         )
         self.registry.gauge(
             "plane_shard_queue_depth",
-            "deepest shard effects SPSC queue right now",
-            fn=lambda: float(max(len(q) for q in self._effects)),
+            "deepest shard effects handoff lane right now (queue items "
+            "for thread shards, ring slots for process shards)",
+            fn=lambda: float(max(
+                max(len(q) for q in self._effects),
+                max((len(r) for r in self._live_rings()), default=0),
+            )),
+        )
+        self.registry.gauge(
+            "plane_shard_effects_dropped",
+            "shard handoff records refused at lane capacity "
+            "(producer-side drop accounting; should be 0)",
+            fn=lambda: float(self.effects_dropped),
         )
         self._handoff_hist = self.registry.histogram(
             "plane_shard_handoff_ns",
@@ -280,6 +343,7 @@ class ShardedPlane:
     def echo_threshold(self, value: int) -> None:
         for core in self._cores:
             core.echo_threshold = value
+        self._proc_push_thresholds()
 
     @property
     def ready_threshold(self) -> int:
@@ -289,6 +353,18 @@ class ShardedPlane:
     def ready_threshold(self, value: int) -> None:
         for core in self._cores:
             core.ready_threshold = value
+        self._proc_push_thresholds()
+
+    def _proc_push_thresholds(self) -> None:
+        if not self._proc or not self._executor._started:
+            return
+        payload = struct.pack(
+            "<II",
+            self._cores[0].echo_threshold,
+            self._cores[0].ready_threshold,
+        )
+        for ring in self._executor.actions:
+            ring.put(pw.C_THRESH, payload)
 
     @property
     def on_attest(self):
@@ -308,7 +384,17 @@ class ShardedPlane:
     async def start(self) -> None:
         from ..native import ingest_available
 
+        # pre-build BEFORE spawning workers: they load the cached .so
         await asyncio.get_running_loop().run_in_executor(None, ingest_available)
+        if self._proc:
+            self._executor.start(self._make_worker_spec)
+            self._proc_push_thresholds()
+            for doc in self._pending_wm_restore:
+                payload = json.dumps(doc).encode()
+                for ring in self._executor.actions:
+                    ring.put(pw.C_WM_RESTORE, payload)
+            self._pending_wm_restore.clear()
+            self._tasks.append(asyncio.create_task(self._flusher()))
         for _ in range(self.workers):
             self._tasks.append(asyncio.create_task(self._worker()))
         self._tasks.append(asyncio.create_task(self._gc_loop()))
@@ -318,7 +404,36 @@ class ShardedPlane:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
+        if self._proc:
+            # stop workers FIRST (they flush state on shutdown), fold
+            # their final effects in, then unlink the rings
+            self._executor.stop_workers()
+            try:
+                self._flush_proc_effects()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
         self._executor.shutdown()
+
+    def _make_worker_spec(
+        self, sid: int, actions_ring: str, effects_ring: str
+    ) -> WorkerSpec:
+        return WorkerSpec(
+            shard_id=sid,
+            shards=self.shards,
+            sign_seed=self.keypair.private_bytes,
+            echo_threshold=self._cores[0].echo_threshold,
+            ready_threshold=self._cores[0].ready_threshold,
+            overlap_ready=self._overlap_ready,
+            peers=tuple(
+                (p.address, p.exchange_public, p.sign_public, p.region)
+                for p in self.mesh.peers
+            ),
+            actions_ring=actions_ring,
+            effects_ring=effects_ring,
+            ring_slots=self._executor.ring_slots,
+            ring_slot_bytes=self._executor.ring_slot_bytes,
+            parent_pid=os.getpid(),
+        )
 
     # -- ingress (mirrors Broadcast.on_frame admission exactly) -----------
 
@@ -386,26 +501,78 @@ class ShardedPlane:
             t_plane = ph.begin_plane() if ph is not None else 0
             t0 = ph.t() if ph is not None else 0
             try:
-                msgs = self._cores[0]._parse_chunk(chunk)
-                if ph is not None:
-                    ph.add("rx_decode", t0)
-                await self._process_chunk(msgs)
+                if self._proc:
+                    # process mode: the owner's whole hot path is ONE
+                    # native parse+route call and ring copies — no
+                    # message objects, no verify_wait on this loop
+                    self._dispatch_chunk_proc(chunk)
+                    if ph is not None:
+                        ph.add("rx_decode", t0)
+                else:
+                    msgs = self._parse_chunk_routed(chunk)
+                    if ph is not None:
+                        ph.add("rx_decode", t0)
+                    await self._process_chunk(msgs)
             except Exception:
                 logger.exception("sharded plane worker error")
             if ph is not None:
                 ph.end_plane(t_plane)
 
+    def _parse_chunk_routed(self, chunk) -> list:
+        """Parse a drained chunk into ``(peer, msg, shard_id)`` triples.
+
+        The fused native call (at2_plane_drain) computes the owning
+        shard for every message IN the GIL-released parse pass, so the
+        owner loop never runs the per-message isinstance routing chain;
+        the Python fallback derives the same ids via :func:`shard_of`
+        (differentially pinned in tests/test_plane_shards.py). Ordering
+        is exactly ``Broadcast._parse_chunk``'s: local objects first in
+        chunk order, then frame messages in frame order."""
+        from ..native import plane_drain_native, plane_drain_ready
+
+        out = []
+        frames: list = []
+        frame_peers: list = []
+        for peer, item in chunk:
+            if isinstance(item, (bytes, bytearray, memoryview)):
+                frames.append(bytes(item))
+                frame_peers.append(peer)
+            else:
+                out.append((peer, item, self._route(item)))
+        if not frames:
+            return out
+        total_bytes = sum(len(f) for f in frames)
+        if total_bytes >= 4096 and plane_drain_ready():
+            items, frame_ok, _counts = plane_drain_native(frames, self.shards)
+            for i, ok in enumerate(frame_ok):
+                if not ok:
+                    peer = frame_peers[i]
+                    logger.warning(
+                        "bad frame from %s",
+                        peer.address if peer is not None else "local",
+                    )
+            out.extend(
+                (frame_peers[fi], msg, sid) for fi, sid, msg in items
+            )
+        else:
+            parsed = self._cores[0]._parse_chunk(
+                list(zip(frame_peers, frames))
+            )
+            out.extend((peer, msg, self._route(msg)) for peer, msg in parsed)
+        return out
+
     async def _process_chunk(self, msgs) -> None:
         """Stage 1 per message in ARRIVAL order on the owning core, ONE
         bulk verify for the whole chunk, stage 3 in arrival order
-        (inline) or grouped per shard on the executor (threaded)."""
+        (inline) or grouped per shard on the executor (threaded).
+        ``msgs`` are ``(peer, msg, shard_id)`` triples from
+        :meth:`_parse_chunk_routed`."""
         ph = self.phases
         t0 = ph.t() if ph is not None else 0
         to_verify: list = []
         actions: list = []  # (shard_id, (kind, msg, n_sigs))
         scratch: list = []
-        for peer, msg in msgs:
-            sid = self._route(msg)
+        for peer, msg, sid in msgs:
             self._cores[sid]._pre_msg(peer, msg, to_verify, scratch)
             if scratch:
                 actions.append((sid, scratch[0]))
@@ -465,6 +632,195 @@ class ShardedPlane:
             except Exception:
                 logger.exception("shard action error")
 
+    # -- process-mode owner loop ------------------------------------------
+
+    def _dispatch_chunk_proc(self, chunk) -> None:
+        """Process-mode stage 1: ONE native parse+route call over the
+        chunk's frames, then flat ``peer_sign + wire`` records into each
+        owning shard's actions ring. Slot-bound kinds never become
+        Python objects on the owner; control kinds (catchup, directory,
+        config, beacon) are peeled off and dispatched through core 0's
+        handlers right here. A record that does not fit its ring is
+        dropped with producer-side accounting (``effects_dropped``) —
+        the same best-effort contract as every other plane lane."""
+        from ..native import plane_drain_native, plane_drain_ready
+
+        rings = self._executor.actions
+        frames: list = []
+        frame_peers: list = []
+        for peer, item in chunk:
+            if isinstance(item, (bytes, bytearray, memoryview)):
+                frames.append(bytes(item))
+                frame_peers.append(peer)
+            else:
+                # locally-submitted Payload/TxBatch: encode and ship to
+                # the owning shard (the sentinel peer means "local")
+                rings[self._route(item)].put(
+                    pw.C_MSG, pw._LOCAL_SENTINEL + item.encode()
+                )
+        if not frames:
+            return
+        if plane_drain_ready():
+            items, frame_ok, _counts = plane_drain_native(
+                frames, self.shards, want_objects=False
+            )
+            for i, ok in enumerate(frame_ok):
+                if not ok:
+                    peer = frame_peers[i]
+                    logger.warning(
+                        "bad frame from %s",
+                        peer.address if peer is not None else "local",
+                    )
+            for fidx, sid, kind, wire in items:
+                if kind in _SLOT_KINDS:
+                    peer = frame_peers[fidx]
+                    pub = (
+                        peer.sign_public if peer is not None
+                        else pw._LOCAL_SENTINEL
+                    )
+                    rings[sid].put(pw.C_MSG, pub + wire)
+                else:
+                    self._ctrl_dispatch_wire(frame_peers[fidx], wire)
+        else:
+            parsed = self._cores[0]._parse_chunk(
+                list(zip(frame_peers, frames))
+            )
+            for peer, msg in parsed:
+                if isinstance(msg, _SLOT_TYPES):
+                    pub = (
+                        peer.sign_public if peer is not None
+                        else pw._LOCAL_SENTINEL
+                    )
+                    rings[self._route(msg)].put(pw.C_MSG, pub + msg.encode())
+                else:
+                    self._ctrl_dispatch(peer, msg)
+
+    def _ctrl_dispatch_wire(self, peer, wire: bytes) -> None:
+        from .messages import WireError, parse_frame
+
+        try:
+            msgs = parse_frame(wire)
+        except WireError:  # pragma: no cover - native already validated
+            return
+        for msg in msgs:
+            self._ctrl_dispatch(peer, msg)
+
+    def _ctrl_dispatch(self, peer, msg) -> None:
+        """Owner-side control dispatch through core 0's handler seam
+        (control kinds touch no shard slot state, only service hooks)."""
+        scratch_v: list = []
+        scratch_a: list = []
+        try:
+            self._cores[0]._pre_msg(peer, msg, scratch_v, scratch_a)
+        except Exception:
+            logger.exception("control dispatch error")
+
+    async def _flusher(self) -> None:
+        """Process-mode owner task: poll every shard's effects ring,
+        apply records, and watch worker health. Adaptive cadence: tight
+        while records flow, relaxed when idle (the handoff histogram
+        keeps the latency honest either way)."""
+        while True:
+            try:
+                n = self._flush_proc_effects()
+                self._poll_workers()
+            except Exception:
+                logger.exception("plane effects flush error")
+                n = 0
+            self._maybe_fire_stall()
+            await asyncio.sleep(0.0005 if n else 0.002)
+
+    def _flush_proc_effects(self) -> int:
+        """Drain + apply every worker's effect records on the owner
+        loop. Returns the number of records applied."""
+        total = 0
+        worst = 0
+        by_sign = self.mesh.by_sign
+        for sid, ring in enumerate(self._executor.effects):
+            recs, handoff = ring.drain()
+            if handoff > worst:
+                worst = handoff
+            for kind, payload in recs:
+                if kind == pw.E_SEND:
+                    peer = by_sign.get(payload[:32])
+                    if peer is not None:
+                        self.mesh.send(peer, payload[32:])
+                elif kind == pw.E_BCAST:
+                    self.mesh.broadcast(payload)
+                elif kind == pw.E_DELIVER:
+                    msg = Payload.decode_body(payload[:140])
+                    object.__setattr__(msg, "_chash", payload[140:172])
+                    self.delivered.put_nowait(msg)
+                elif kind == pw.E_STALL:
+                    self._stall_pending = True
+                elif kind == pw.E_STATS:
+                    for i, key in enumerate(STAT_KEYS):
+                        delta = int.from_bytes(
+                            payload[i * 8 : (i + 1) * 8], "little"
+                        )
+                        if delta:
+                            self.stats[key] += delta
+                elif kind == pw.E_WM:
+                    key = payload[1:33]
+                    seq = int.from_bytes(payload[33:41], "little")
+                    wm = (
+                        self._proc_wm_tx if payload[0] == 0
+                        else self._proc_wm_batch
+                    )
+                    if wm.get(key, -1) < seq:
+                        wm[key] = seq
+                elif kind == pw.E_INFO:
+                    undeliv, floors = struct.unpack("<IQ", payload)
+                    self._proc_undeliv[sid] = undeliv
+                    self._proc_floor_refusals[sid] = floors
+            total += len(recs)
+        if worst > 0:
+            self._handoff_hist.observe(worst)
+        return total
+
+    def _poll_workers(self) -> None:
+        """Surface worker deaths exactly once each: crash ledger for
+        /healthz attribution, flight-recorder code, service hook. The
+        plane keeps draining — surviving shards stay live, the dead
+        shard's traffic drops with accounting until an operator
+        restarts the node (degraded, never hung)."""
+        for sid, code in self._executor.poll_crashed():
+            self.worker_crashed[sid] = code
+            logger.error(
+                "plane shard %d worker died (exit %s)", sid, code
+            )
+            if self.recorder is not None:
+                try:
+                    self.recorder.snapshot(
+                        f"plane_worker_crash:shard={sid},exit={code}"
+                    )
+                except Exception:
+                    logger.exception("crash snapshot failed")
+            hook = self.on_worker_crash
+            if hook is not None:
+                try:
+                    hook(sid, code)
+                except Exception:
+                    logger.exception("worker-crash hook error")
+
+    def _live_rings(self):
+        if not self._proc or not self._executor._started:
+            return ()
+        return (*self._executor.actions, *self._executor.effects)
+
+    @property
+    def effects_dropped(self) -> int:
+        """Producer-side handoff drops across EVERY lane: the in-process
+        SPSC queues (thread mode) plus both ring directions (process
+        mode). Exported as ``plane_shard_effects_dropped``."""
+        total = sum(q.dropped for q in self._effects)
+        for ring in self._live_rings():
+            try:
+                total += ring.dropped
+            except Exception:  # pragma: no cover - ring torn down
+                pass
+        return total
+
     # -- effects + stall marshaling ---------------------------------------
 
     def _fire_stall(self) -> None:
@@ -519,6 +875,12 @@ class ShardedPlane:
             now = self.clock.monotonic()
             if self._inline:
                 self._gc_pass_global(now)
+            elif self._proc:
+                # workers GC their own slots; CLOCK_MONOTONIC is one
+                # clock machine-wide, so the owner's now is theirs
+                payload = struct.pack("<d", now)
+                for ring in self._executor.actions:
+                    ring.put(pw.C_GC, payload)
             else:
                 futs = [
                     self._executor.submit(sid, core._gc_pass, now)
@@ -571,11 +933,19 @@ class ShardedPlane:
     def release_entry(self, sender: bytes, sequence: int) -> None:
         # the registry is shared: one pop releases the binding plane-wide
         self._cores[0].release_entry(sender, sequence)
+        if self._proc and self._executor._started:
+            # process workers each hold a registry; the binding lives on
+            # whichever worker bound it — fan the release (no-op pops)
+            payload = sender + struct.pack("<Q", sequence)
+            for ring in self._executor.actions:
+                ring.put(pw.C_RELEASE, payload)
 
     def export_watermarks(self) -> dict:
         """Merge per-shard watermark exports. Keys partition by shard for
         LIVE attestation bumps, but restored floors are fanned to every
-        core, so merge with max to stay monotone either way."""
+        core, so merge with max to stay monotone either way. Process
+        workers stream their bumps through the effects ring; the merged
+        owner-side dicts are folded in here."""
         tx: Dict[str, int] = {}
         batch: Dict[str, int] = {}
         for core in self._cores:
@@ -584,19 +954,39 @@ class ShardedPlane:
                 tx[k] = max(tx.get(k, 0), v)
             for k, v in doc["batch"].items():
                 batch[k] = max(batch.get(k, 0), v)
+        for key, v in self._proc_wm_tx.items():
+            k = key.hex()
+            tx[k] = max(tx.get(k, 0), v)
+        for key, v in self._proc_wm_batch.items():
+            k = key.hex()
+            batch[k] = max(batch.get(k, 0), v)
         return {"tx": tx, "batch": batch}
 
     def restore_watermarks(self, doc: dict) -> None:
         for core in self._cores:
             core.restore_watermarks(doc)
+        if self._proc:
+            if self._executor._started:
+                payload = json.dumps(doc).encode()
+                for ring in self._executor.actions:
+                    ring.put(pw.C_WM_RESTORE, payload)
+            else:
+                # the usual service order is restore-then-start: queue
+                # the doc and replay it right after the workers spawn
+                self._pending_wm_restore.append(doc)
 
     def plane_info(self) -> dict:
         """The /statusz ``plane`` block (tools/top.py shards column)."""
-        return {
+        info = {
             "shards": self.shards,
             "executor": self._executor.name,
-            "effects_dropped": sum(q.dropped for q in self._effects),
+            "effects_dropped": self.effects_dropped,
         }
+        if self.worker_crashed:
+            info["worker_crashed"] = {
+                str(sid): code for sid, code in self.worker_crashed.items()
+            }
+        return info
 
     # handler hooks are plain attributes on Broadcast; fan writes through
     # so cores see the service's callbacks (the sharded plane routes

@@ -15,6 +15,8 @@ from .ingest import (
     ingest_ready_or_kick,
     kick_ingest_build,
     parse_frames_native,
+    plane_drain_native,
+    plane_drain_ready,
     quorum_mask_native,
     verify_bulk_native,
 )
@@ -31,6 +33,8 @@ __all__ = [
     "kick_ingest_build",
     "native_available",
     "parse_frames_native",
+    "plane_drain_native",
+    "plane_drain_ready",
     "prep_batch_native",
     "quorum_mask_native",
     "verify_bulk_native",

@@ -202,6 +202,10 @@ inline uint32_t le32(const uint8_t* p) {
          (uint32_t(p[3]) << 24);
 }
 
+inline uint64_t le64(const uint8_t* p) {
+  return uint64_t(le32(p)) | (uint64_t(le32(p + 4)) << 32);
+}
+
 // Output record: one fixed-stride row per message.
 //   byte 0            : kind (0 = row unused)
 //   GOSSIP  row [1..141): the 140-byte wire body, [141..173): content hash
@@ -255,15 +259,20 @@ inline bool read_varint(const uint8_t* buf, size_t len, size_t& off,
 
 extern "C" {
 
-// Parse n_frames concatenated-message frames (flat + offsets, like the
-// prep library's ragged layout) into fixed rows. Returns the number of
-// messages written, or -1 if `cap` rows were not enough (caller resizes
-// and retries). A malformed frame sets frame_ok[f]=0 and contributes no
-// rows (mirrors on_frame's per-frame drop); well-formed frames set 1.
-// msg_frame[i] = source frame index of row i (the peer association).
-int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
-                         int64_t n_frames, uint8_t* rows, int64_t cap,
-                         uint32_t* msg_frame, uint8_t* frame_ok) {
+// Shared parse loop behind at2_parse_frames and at2_plane_drain: when
+// `shard_ids` is non-null, every row additionally gets its owning
+// shard — computed from the SLOT origin key exactly like
+// broadcast/shards.shard_of (first 8 key bytes, little-endian, modulo):
+//   GOSSIP/REQUEST            -> sender      (body offset 0)
+//   ECHO/READY                -> sender      (body offset 32; byte 0..32
+//                                             is the attesting origin)
+//   BATCH/BATCH_REQ           -> batch origin (body offset 0)
+//   BATCH_ECHO/BATCH_READY    -> batch origin (body offset 32)
+//   control kinds             -> shard 0 (stateless wrt shard slots)
+static int64_t parse_frames_impl(const uint8_t* flat, const uint64_t* offsets,
+                                 int64_t n_frames, uint8_t* rows, int64_t cap,
+                                 uint32_t* msg_frame, uint8_t* frame_ok,
+                                 int64_t shards, uint32_t* shard_ids) {
   int64_t n_out = 0;
   for (int64_t f = 0; f < n_frames; f++) {
     const uint8_t* p = flat + offsets[f];
@@ -326,6 +335,18 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
         std::memcpy(row + 1, p + 1, wire - 1);
         if (kind == kGossip) sha256(p + 1, 140, row + 141);
       }
+      if (shard_ids != nullptr) {
+        const uint8_t* rkey = nullptr;
+        if (kind == kGossip || kind == kRequest || kind == kBatch ||
+            kind == kBatchReq) {
+          rkey = p + 1;  // sender / batch origin leads the body
+        } else if (kind == kEcho || kind == kReady || kind == kBatchEcho ||
+                   kind == kBatchReady) {
+          rkey = p + 33;  // slot key follows the attesting origin
+        }
+        shard_ids[n_out] =
+            rkey ? uint32_t(le64(rkey) % uint64_t(shards)) : 0;
+      }
       msg_frame[n_out] = uint32_t(f);
       n_out++;
       p += wire;
@@ -334,6 +355,42 @@ int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
     if (!ok) n_out = start;  // drop the whole frame, like parse_frame
   }
   return n_out;
+}
+
+// Parse n_frames concatenated-message frames (flat + offsets, like the
+// prep library's ragged layout) into fixed rows. Returns the number of
+// messages written, or -1 if `cap` rows were not enough (caller resizes
+// and retries). A malformed frame sets frame_ok[f]=0 and contributes no
+// rows (mirrors on_frame's per-frame drop); well-formed frames set 1.
+// msg_frame[i] = source frame index of row i (the peer association).
+int64_t at2_parse_frames(const uint8_t* flat, const uint64_t* offsets,
+                         int64_t n_frames, uint8_t* rows, int64_t cap,
+                         uint32_t* msg_frame, uint8_t* frame_ok) {
+  return parse_frames_impl(flat, offsets, n_frames, rows, cap, msg_frame,
+                           frame_ok, 1, nullptr);
+}
+
+// The owner drain loop's ONE GIL-released call (ISSUE 17): parse a whole
+// chunk of frames AND route every row to its owning shard in the same
+// pass, so the Python side goes straight from raw frames to per-shard
+// record batches with no per-message isinstance dispatch. Outputs are
+// at2_parse_frames' plus shard_ids[i] (owning shard of row i) and
+// shard_counts[s] (rows routed to shard s, rollback-corrected for
+// malformed frames). Quorum folding stays in at2_counts_add /
+// at2_quorum_mask, which the shard cores call per transition — this
+// kernel's job is everything BEFORE the cores: validate, extract, hash,
+// route, tally.
+int64_t at2_plane_drain(const uint8_t* flat, const uint64_t* offsets,
+                        int64_t n_frames, int64_t shards, uint8_t* rows,
+                        int64_t cap, uint32_t* msg_frame, uint8_t* frame_ok,
+                        uint32_t* shard_ids, int64_t* shard_counts) {
+  if (shards <= 0) return -2;
+  int64_t n = parse_frames_impl(flat, offsets, n_frames, rows, cap,
+                                msg_frame, frame_ok, shards, shard_ids);
+  if (n < 0) return n;
+  for (int64_t s = 0; s < shards; s++) shard_counts[s] = 0;
+  for (int64_t i = 0; i < n; i++) shard_counts[shard_ids[i]]++;
+  return n;
 }
 
 // Bulk ed25519 verify: out[i] = 1 iff signature i verifies under OpenSSL

@@ -376,22 +376,42 @@ class PlaneConfig:
     ``shards > 1`` partitions slot state per origin key across that many
     shard cores; ``executor`` picks where their drain work runs:
     ``"thread"`` (one OS thread per shard; scaling comes from the
-    GIL-released native kernels) or ``"inline"`` (synchronous on the
+    GIL-released native kernels), ``"process"`` (one spawn worker
+    process per shard over shared-memory rings — true parallelism for
+    the Python-level admission/quorum/verify work, see
+    parallel/plane_worker.py), or ``"inline"`` (synchronous on the
     event loop — the deterministic mode the sim forces, also useful to
     measure sharding overhead without threads). ``workers`` is the
-    owner-loop drain task count for the sharded ingress."""
+    owner-loop drain task count for the sharded ingress.
+
+    ``ring_slots`` / ``ring_slot_bytes`` size the per-shard
+    shared-memory rings process mode uses (parallel/ring.py): each of
+    the two rings per shard is ``ring_slots * ring_slot_bytes`` of
+    /dev/shm. A record that does not fit is DROPPED with producer-side
+    accounting (``plane_shard_effects_dropped`` on /metrics), so
+    undersizing degrades visibly rather than blocking the plane. The
+    defaults (4096 x 1 KiB = 4 MiB per direction per shard) hold ~20 ms
+    of a saturated shard's traffic."""
 
     shards: int = 1
     executor: str = "thread"
     workers: int = 4
+    ring_slots: int = 4096
+    ring_slot_bytes: int = 1024
 
     def __post_init__(self) -> None:
         if self.shards < 1:
             raise ValueError("plane.shards must be >= 1")
-        if self.executor not in ("thread", "inline"):
-            raise ValueError("plane.executor must be 'thread' or 'inline'")
+        if self.executor not in ("thread", "inline", "process"):
+            raise ValueError(
+                "plane.executor must be 'thread', 'inline' or 'process'"
+            )
         if self.workers < 1:
             raise ValueError("plane.workers must be >= 1")
+        if self.ring_slots < 1:
+            raise ValueError("plane.ring_slots must be >= 1")
+        if self.ring_slot_bytes < 16:
+            raise ValueError("plane.ring_slot_bytes must be >= 16")
 
 
 @dataclass
@@ -680,6 +700,8 @@ class Config:
                 f"shards = {pl.shards}",
                 f'executor = "{pl.executor}"',
                 f"workers = {pl.workers}",
+                f"ring_slots = {pl.ring_slots}",
+                f"ring_slot_bytes = {pl.ring_slot_bytes}",
             ]
         wa = self.wan
         if wa != WanConfig():

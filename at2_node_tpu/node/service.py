@@ -543,8 +543,10 @@ class Service(At2Servicer):
             if plane_cfg.shards > 1:
                 # sharded broadcast plane (broadcast/shards.py). Under a
                 # non-system clock the executor is forced inline: the sim
-                # owns the schedule and shard threads would race it —
-                # inline keeps shards=N byte-identical on the wire.
+                # owns the schedule and shard threads/processes would
+                # race it — inline keeps shards=N byte-identical on the
+                # wire regardless of the configured executor (the CI
+                # campaign-hash sweep pins this across all three).
                 from ..broadcast.shards import ShardedPlane
                 from ..clock import SYSTEM_CLOCK
 
@@ -558,6 +560,8 @@ class Service(At2Servicer):
                     shards=plane_cfg.shards,
                     executor=executor,
                     workers=plane_cfg.workers,
+                    ring_slots=plane_cfg.ring_slots,
+                    ring_slot_bytes=plane_cfg.ring_slot_bytes,
                     echo_threshold=config.echo_threshold,
                     ready_threshold=config.ready_threshold,
                     registry=service.registry,
@@ -1376,11 +1380,19 @@ class Service(At2Servicer):
         # (obs/audit.py zero-false-positive compare), so the node must
         # fail probes until an operator intervenes
         diverged = self.auditor.divergence is not None
+        # a dead plane-shard worker process (process executor only) is a
+        # permanent capacity loss: that shard's origins stop making
+        # progress while everything else stays live. Degraded with shard
+        # attribution — never a silent hang.
+        plane_crashed = dict(
+            getattr(self.broadcast, "worker_crashed", None) or {}
+        )
         ok = (
             quorum_ok
             and not stalled
             and not slo_breach
             and not diverged
+            and not plane_crashed
             and not self._closing
         )
         # a store-backed restart reports "recovering" until catchup lag
@@ -1400,6 +1412,10 @@ class Service(At2Servicer):
                 reason = "stalled"
             elif not quorum_ok:
                 reason = "quorum_lost"
+            elif plane_crashed:
+                reason = "plane_worker:" + ",".join(
+                    f"shard={sid}" for sid in sorted(plane_crashed)
+                )
             else:
                 reason = "slo:" + ",".join(slo_breach)
             self.recorder.snapshot("healthz_degraded:" + reason)
@@ -1447,6 +1463,9 @@ class Service(At2Servicer):
             "quorum_ok": quorum_ok,
             "stalled": stalled,
             "slo_breach": slo_breach,
+            "plane_workers_crashed": {
+                str(sid): code for sid, code in sorted(plane_crashed.items())
+            },
             "divergence": self.auditor.divergence,
             "pending": len(self._heap),
             "committed": self.committed,

@@ -16,20 +16,34 @@ and keep the whole plane deterministic:
   pool each, so shard state is confined to exactly one thread for its
   lifetime). Python-level work still serializes on the GIL; the
   scaling comes from the native quorum/parse kernels releasing it.
-  Process or subinterpreter executors slot in behind the same protocol
-  later without touching the plane.
+- ``ProcessPlaneExecutor`` breaks the GIL outright: one spawn worker
+  process per shard, each owning its whole shard core
+  (parallel/plane_worker.py), with a pair of fixed-slot shared-memory
+  rings per shard (parallel/ring.py) as the only channel. The owner
+  loop routes flat wire records in and applies flat effect records
+  out; Python-level shard work (admission, quorum transitions, the
+  verify term itself) runs on genuinely independent cores.
 - ``SPSCQueue`` is the bounded single-producer single-consumer lane a
-  shard uses to hand effects back to the owner loop. Bounded so a
-  stalled owner exerts backpressure instead of growing without limit;
-  instrumented so /metrics can show depth and handoff latency.
+  THREAD shard uses to hand effects back to the owner loop: same
+  address space, so records are plain object references and the GIL
+  makes deque ops atomic — serializing them through a byte ring would
+  only add copies. Process shards use ``ShmRing``, the cross-address-
+  space twin with the same bounded/drop-accounted/latency-instrumented
+  contract. Both are bounded so a stalled owner exerts backpressure
+  instead of growing without limit; both feed the same
+  ``plane_shard_handoff_ns`` histogram and ``effects_dropped`` export.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
+import multiprocessing
+import os
 import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
+
+from .ring import ShmRing
 
 
 class SPSCQueue:
@@ -144,10 +158,149 @@ class ThreadPlaneExecutor:
             p.shutdown(wait=False, cancel_futures=True)
 
 
-def make_plane_executor(kind: str, shards: int):
+class ProcessPlaneExecutor:
+    """One spawn worker PROCESS per shard — true parallelism.
+
+    The executor owns the per-shard ring pair (actions owner->worker,
+    effects worker->owner) and the worker lifecycle; the sharded plane
+    owns the protocol (what goes into the rings and how effects apply).
+    Spawn, not fork: the owner runs an event loop, executor threads and
+    (on TPU hosts) a JAX runtime, none of which survive a fork — spawn
+    children import fresh from a picklable :class:`WorkerSpec`.
+
+    Lifecycle contract (production-shaped):
+
+    * ``shutdown()`` sends every live worker a SHUTDOWN record, joins
+      with a bounded timeout, terminates stragglers, and unlinks the
+      rings — a clean exit leaves nothing in /dev/shm;
+    * ``poll_crashed()`` reports workers that died UNINVITED (exitcode
+      without a shutdown in flight) exactly once each, so the plane can
+      flip /healthz degraded with shard attribution instead of hanging;
+    * workers reap themselves if the owner dies (the getppid check in
+      plane_worker.worker_main) — orphan processes never accumulate.
+    """
+
+    name = "process"
+
+    def __init__(
+        self,
+        shards: int,
+        *,
+        ring_slots: int = 4096,
+        ring_slot_bytes: int = 1024,
+    ):
+        if shards <= 0:
+            raise ValueError("ProcessPlaneExecutor needs >= 1 shard")
+        self.shards = shards
+        self.ring_slots = ring_slots
+        self.ring_slot_bytes = ring_slot_bytes
+        self.actions: List[ShmRing] = []
+        self.effects: List[ShmRing] = []
+        self._procs: list = []
+        self._crashed: dict = {}  # sid -> exitcode, reported once
+        self._closing = False
+        self._started = False
+
+    def start(self, make_spec: Callable[[int, str, str], Any]) -> None:
+        """Create the rings, then spawn one worker per shard.
+        ``make_spec(shard_id, actions_ring, effects_ring)`` builds the
+        picklable spec (broadcast/shards.py supplies it)."""
+        if self._started:
+            return
+        self._started = True
+        from .plane_worker import worker_main
+
+        base = f"at2pl-{os.getpid()}-{os.urandom(3).hex()}"
+        for sid in range(self.shards):
+            self.actions.append(ShmRing(
+                f"{base}-a{sid}", slots=self.ring_slots,
+                slot_bytes=self.ring_slot_bytes, create=True,
+            ))
+            self.effects.append(ShmRing(
+                f"{base}-e{sid}", slots=self.ring_slots,
+                slot_bytes=self.ring_slot_bytes, create=True,
+            ))
+        ctx = multiprocessing.get_context("spawn")
+        for sid in range(self.shards):
+            proc = ctx.Process(
+                target=worker_main,
+                args=(make_spec(
+                    sid, self.actions[sid].name, self.effects[sid].name
+                ),),
+                daemon=True,
+                name=f"plane-shard-{sid}",
+            )
+            proc.start()
+            self._procs.append(proc)
+
+    def alive(self, shard_id: int) -> bool:
+        return (
+            shard_id < len(self._procs) and self._procs[shard_id].is_alive()
+        )
+
+    def poll_crashed(self) -> List[Tuple[int, int]]:
+        """Newly-dead workers as ``(shard_id, exitcode)``, each reported
+        exactly once. Empty during/after an intentional shutdown."""
+        if self._closing or not self._started:
+            return []
+        out = []
+        for sid, proc in enumerate(self._procs):
+            if sid not in self._crashed and not proc.is_alive():
+                code = proc.exitcode if proc.exitcode is not None else -1
+                self._crashed[sid] = code
+                out.append((sid, code))
+        return out
+
+    @property
+    def crashed(self) -> dict:
+        """All shard crashes seen so far: ``{shard_id: exitcode}``."""
+        return dict(self._crashed)
+
+    def submit(self, shard_id: int, fn, *args):
+        raise RuntimeError(
+            "process plane shards run in workers, not owner closures"
+        )
+
+    def stop_workers(self) -> None:
+        """Send SHUTDOWN, join with a bounded timeout, terminate
+        stragglers. Rings stay open so the caller can drain the final
+        state flush the workers emit on the way out."""
+        if self._closing:
+            return
+        self._closing = True
+        from .plane_worker import C_SHUTDOWN
+
+        for sid, proc in enumerate(self._procs):
+            if proc.is_alive():
+                self.actions[sid].put(C_SHUTDOWN, b"")
+        for proc in self._procs:
+            proc.join(timeout=2.0)
+            if proc.is_alive():  # pragma: no cover - stuck worker
+                proc.terminate()
+                proc.join(timeout=1.0)
+
+    def shutdown(self) -> None:
+        self.stop_workers()
+        for ring in (*self.actions, *self.effects):
+            ring.close()
+        self.actions = []
+        self.effects = []
+
+
+def make_plane_executor(
+    kind: str,
+    shards: int,
+    *,
+    ring_slots: int = 4096,
+    ring_slot_bytes: int = 1024,
+):
     """Factory behind the config seam: ``[plane] executor = ...``."""
     if kind == "inline":
         return InlinePlaneExecutor(shards)
     if kind == "thread":
         return ThreadPlaneExecutor(shards)
+    if kind == "process":
+        return ProcessPlaneExecutor(
+            shards, ring_slots=ring_slots, ring_slot_bytes=ring_slot_bytes
+        )
     raise ValueError(f"unknown plane executor {kind!r}")

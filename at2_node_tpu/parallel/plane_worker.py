@@ -1,0 +1,258 @@
+"""Process-mode plane shard: the spawn target and its wire records.
+
+One worker process owns one shard's ENTIRE :class:`Broadcast` core —
+slots, dedup sets, quorum bitmaps, entry registry, watermarks. The
+memory model is confinement taken one level past the thread executor:
+where a shard thread shares the owner's address space and merely
+promises not to touch cross-shard state, a shard process CANNOT — the
+only channel in or out is a pair of shared-memory rings
+(parallel/ring.py):
+
+* ``actions`` (owner -> worker): routed messages as flat
+  ``peer_sign(32) + wire`` records plus control records (GC ticks,
+  threshold updates, watermark restores, shutdown);
+* ``effects`` (worker -> owner): outbound frames, delivered payload
+  bodies, stall kicks, and periodic state diffs (stats counter deltas,
+  attestation watermarks, gauge snapshots) the owner folds into its
+  shared observability surfaces.
+
+Everything that crosses is bytes that were already bytes on the wire —
+no pickling. Verification happens IN the worker (native bulk ed25519
+when the ingest library is available, per-item OpenSSL otherwise), so
+shard processes genuinely overlap the dominant verify term on separate
+cores with no GIL in common.
+
+The worker is production-shaped about dying: it exits when told
+(SHUTDOWN record), and it exits when ORPHANED — every loop iteration
+checks ``os.getppid()`` against the owner pid captured at spawn, so an
+owner that crashes without cleanup reaps its workers within one poll
+interval instead of leaking them.
+
+This module's import graph is deliberately light (stdlib only at module
+level); the broadcast/crypto imports happen inside :func:`worker_main`
+so the spawn child pays them, not every importer of the parallel
+package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from dataclasses import dataclass
+from typing import Tuple
+
+__all__ = ["WorkerSpec", "worker_main", "STAT_KEYS"]
+
+# owner -> worker control/message record kinds (ShmRing `kind` byte)
+C_MSG = 1  # peer_sign(32) + one-message wire frame
+C_GC = 2  # f64 monotonic now
+C_SHUTDOWN = 3  # clean exit after flushing state
+C_THRESH = 4  # u32 echo_threshold, u32 ready_threshold
+C_WM_RESTORE = 5  # JSON watermark doc (floors fan-in)
+C_RELEASE = 6  # sender(32) + u64 sequence (entry-registry release)
+C_EXIT = 7  # u8 exit code: simulate a worker crash (tests only)
+
+# worker -> owner effect record kinds
+E_SEND = 16  # peer_sign(32) + frame
+E_BCAST = 17  # frame
+E_DELIVER = 18  # payload body(140) + content hash(32)
+E_STALL = 19  # empty
+E_STATS = 20  # len(STAT_KEYS) * u64 counter deltas, STAT_KEYS order
+E_WM = 21  # u8 plane (0=tx 1=batch) + key(32) + u64 sequence
+E_INFO = 22  # u32 undelivered + u64 floor_refusals
+
+# The shared plane counter names, in wire order for E_STATS records.
+# MUST match the counter_group tuples in broadcast/stack.py and
+# broadcast/shards.py (pinned by tests/test_plane_shards.py).
+STAT_KEYS: Tuple[str, ...] = (
+    "gossip_rx",
+    "echo_rx",
+    "ready_rx",
+    "invalid_sig",
+    "delivered",
+    "slots_dropped",
+    "content_req_tx",
+    "content_req_rx",
+    "content_served",
+    "batch_rx",
+    "batch_echo_rx",
+    "batch_ready_rx",
+    "batch_entries_delivered",
+    "retransmits",
+    "poison_resolved",
+    "slots_retired",
+    "stall_kicks_suppressed",
+)
+
+_LOCAL_SENTINEL = bytes(32)  # peer_sign of a locally-submitted message
+
+_u64 = struct.Struct("<Q")
+_info = struct.Struct("<IQ")
+
+
+@dataclass(frozen=True)
+class WorkerSpec:
+    """Everything a spawn child needs; plain picklable data only."""
+
+    shard_id: int
+    shards: int
+    sign_seed: bytes
+    echo_threshold: int
+    ready_threshold: int
+    overlap_ready: bool
+    # ((address, exchange_public, sign_public, region), ...)
+    peers: Tuple[Tuple[str, bytes, bytes, str], ...]
+    actions_ring: str
+    effects_ring: str
+    ring_slots: int
+    ring_slot_bytes: int
+    parent_pid: int
+
+
+class _ProcMesh:
+    """Mesh facade inside the worker: reads serve the core's peer/quorum
+    bookkeeping from the spec's peer table; sends become effect records
+    (the real transports live in the owner process)."""
+
+    __slots__ = ("peers", "by_sign", "_effects")
+
+    def __init__(self, peers, effects) -> None:
+        self.peers = peers
+        self.by_sign = {p.sign_public: p for p in peers}
+        self._effects = effects
+
+    def send(self, peer, data: bytes) -> None:
+        self._effects.put(E_SEND, peer.sign_public + bytes(data))
+
+    def broadcast(self, data: bytes) -> None:
+        self._effects.put(E_BCAST, bytes(data))
+
+
+class _ProcDelivered:
+    """Delivered-queue facade: payload body + content hash cross as one
+    record; the owner rebuilds the Payload (hash pre-seeded, nothing
+    re-hashes) and feeds the real asyncio queue the commit tail reads."""
+
+    __slots__ = ("_effects",)
+
+    def __init__(self, effects) -> None:
+        self._effects = effects
+
+    def put_nowait(self, payload) -> None:
+        self._effects.put(
+            E_DELIVER, payload.encode()[1:] + payload.content_hash()
+        )
+
+
+def _flush_state(core, effects, last) -> None:
+    """Ship observable-state DIFFS to the owner: counter deltas (the
+    owner's group is the plane-wide aggregate), watermark bumps (merged
+    with max on the owner; monotone either way), and the gauge pair."""
+    vals = [int(core.stats[k]) for k in STAT_KEYS]
+    if vals != last["stats"]:
+        deltas = [v - o for v, o in zip(vals, last["stats"])]
+        effects.put(E_STATS, b"".join(_u64.pack(max(0, d)) for d in deltas))
+        last["stats"] = vals
+    for tag, wm, seen in (
+        (0, core._wm_tx, last["wm_tx"]),
+        (1, core._wm_batch, last["wm_batch"]),
+    ):
+        for key, seq in wm.items():
+            if seen.get(key) != seq:
+                effects.put(E_WM, bytes([tag]) + key + _u64.pack(seq))
+                seen[key] = seq
+    info = (core._undelivered, core.floor_refusals)
+    if info != last["info"]:
+        effects.put(
+            E_INFO, _info.pack(max(0, core._undelivered), core.floor_refusals)
+        )
+        last["info"] = info
+
+
+def worker_main(spec: WorkerSpec) -> None:
+    """Spawn entry point: build this shard's core, then drain the
+    actions ring forever (parse -> admission pre-checks -> bulk verify
+    -> state transitions -> effect records), exactly the three-stage
+    pipeline the owner loop runs, minus everything cross-shard."""
+    from ..broadcast.messages import WireError, parse_frame
+    from ..broadcast.stack import Broadcast
+    from ..crypto.keys import SignKeyPair, verify_one
+    from ..native import ingest_available, verify_bulk_native
+    from ..net.peers import Peer
+    from .ring import ShmRing
+
+    actions_ring = ShmRing(spec.actions_ring)
+    effects = ShmRing(spec.effects_ring)
+    peers = [
+        Peer(address=a, exchange_public=x, sign_public=s, region=r)
+        for a, x, s, r in spec.peers
+    ]
+    mesh = _ProcMesh(peers, effects)
+    core = Broadcast(
+        SignKeyPair(spec.sign_seed),
+        mesh,
+        None,  # verifier unused: this loop verifies, not _process_chunk
+        echo_threshold=spec.echo_threshold,
+        ready_threshold=spec.ready_threshold,
+        workers=0,
+        overlap_ready=spec.overlap_ready,
+    )
+    core.delivered = _ProcDelivered(effects)
+    core.stall_handler = lambda: effects.put(E_STALL, b"")
+    # .so already compiled by the owner's start(); this is a cached load
+    native = ingest_available()
+
+    last = {
+        "stats": [0] * len(STAT_KEYS),
+        "wm_tx": {},
+        "wm_batch": {},
+        "info": (0, 0),
+    }
+    idle = 0.0002
+    stop = False
+    while not stop:
+        if os.getppid() != spec.parent_pid:
+            break  # orphaned: the owner died without a clean shutdown
+        recs, _ = actions_ring.drain()
+        if not recs:
+            time.sleep(idle)
+            idle = min(idle * 2.0, 0.002)
+            continue
+        idle = 0.0002
+        to_verify: list = []
+        acts: list = []
+        for kind, payload in recs:
+            if kind == C_MSG:
+                peer = mesh.by_sign.get(payload[:32])
+                try:
+                    msgs = parse_frame(payload[32:])
+                except WireError:
+                    continue  # owner routed it, so it parsed there; defensive
+                for msg in msgs:
+                    core._pre_msg(peer, msg, to_verify, acts)
+            elif kind == C_GC:
+                core._gc_pass(struct.unpack("<d", payload)[0])
+            elif kind == C_THRESH:
+                core.echo_threshold, core.ready_threshold = struct.unpack(
+                    "<II", payload
+                )
+            elif kind == C_WM_RESTORE:
+                core.restore_watermarks(json.loads(payload.decode()))
+            elif kind == C_RELEASE:
+                core.release_entry(payload[:32], _u64.unpack(payload[32:])[0])
+            elif kind == C_EXIT:  # tests: simulate a crash mid-campaign
+                os._exit(payload[0] if payload else 42)
+            elif kind == C_SHUTDOWN:
+                stop = True
+        if to_verify:
+            if native:
+                results = verify_bulk_native(to_verify, 1)
+            else:
+                results = [verify_one(pk, m, s) for pk, m, s in to_verify]
+            core._apply_actions(acts, results)
+        _flush_state(core, effects, last)
+    _flush_state(core, effects, last)
+    actions_ring.close()
+    effects.close()

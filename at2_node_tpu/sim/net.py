@@ -112,17 +112,20 @@ class SimNet:
         verifier_mode: str = "auto",
         rlc_min_batch: int = 128,
         plane_shards: int = 1,
+        plane_executor: str = "inline",
         **config_overrides,
     ) -> None:
         # convenience for the shard-determinism campaigns: shards > 1
-        # becomes a [plane] table on every node, executor pinned inline
-        # (Service forces inline under the sim clock anyway; pinning here
-        # keeps the dumped config honest about what actually runs)
+        # becomes a [plane] table on every node. ``plane_executor`` is
+        # recorded as configured ("inline"/"thread"/"process") while
+        # Service forces inline under the sim clock regardless — which
+        # is precisely what the executor hash sweep pins: the wire
+        # schedule must not depend on the configured executor.
         if plane_shards > 1 and "plane" not in config_overrides:
             from ..node.config import PlaneConfig
 
             config_overrides["plane"] = PlaneConfig(
-                shards=plane_shards, executor="inline"
+                shards=plane_shards, executor=plane_executor
             )
         self.n = n
         self.f = f

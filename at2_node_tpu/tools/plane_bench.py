@@ -22,15 +22,23 @@ can be measured as the delta between two otherwise-identical runs — the
 ISSUE 6 acceptance budget is <5% throughput regression with both on.
 
 ``--shards N`` runs the firehose against the sharded broadcast plane
-(broadcast/shards.py, one OS thread per shard); ``--shards-grid 1,2,4``
-sweeps the axis — optionally pinned to ``--cores N`` CPUs — and banks
-the scaling grid to BENCH_PLANE_SHARDS.json (same row conventions as
-BENCH_AGGREGATE.json, plus per-row ``host_cores``).
+(broadcast/shards.py); ``--executor thread|process|inline`` picks where
+shard work runs (process = one spawn worker per shard over
+shared-memory rings, parallel/plane_worker.py — the GIL-free mode);
+``--shards-grid 1,2,4`` sweeps the shard axis — optionally pinned to
+``--cores N`` CPUs — and banks the scaling grid to
+BENCH_PLANE_SHARDS.json (same row conventions as BENCH_AGGREGATE.json,
+plus per-row ``executor``, ``host_cores`` and ``captured_at``).
+
+``--compare-drain`` is the drain-fusion proof: phase-accounted runs
+fused (at2_plane_drain) vs unfused (AT2_NO_PLANE_DRAIN=1), banking the
+owner-loop serial-term share delta as a ``phase_accounting`` row.
 
 Usage:
     python -m at2_node_tpu.tools.plane_bench [--nodes 3] [--txs 300]
         [--verifier cpu] [--batch 0] [--obs on|off] [--shards 1]
-        [--shards-grid 1,2,4] [--cores 0] [--out -]
+        [--executor thread] [--shards-grid 1,2,4] [--cores 0]
+        [--compare-drain] [--out -]
 """
 
 from __future__ import annotations
@@ -94,14 +102,14 @@ class _TrustAllVerifier:
 async def run(
     nodes: int, txs: int, verifier: str, timeout: float, batch: int = 0,
     obs: bool = True, profile: bool = False, linger: float = 0.0,
-    shards: int = 1,
+    shards: int = 1, executor: str = "thread",
 ) -> dict:
     plane_only = verifier == "plane-only"
     cfgs = make_net_configs(
         nodes,
         _ports,
         verifier=VerifierConfig(kind="cpu" if plane_only else verifier),
-        plane=PlaneConfig(shards=shards),
+        plane=PlaneConfig(shards=shards, executor=executor),
         observability=(
             ObservabilityConfig()
             if obs
@@ -193,6 +201,7 @@ async def run(
             "verifier": verifier,
             "batch": batch,
             "shards": shards,
+            "executor": "loop" if shards == 1 else executor,
             "obs": obs,
             "profiler": prof,
             "submitted": txs,
@@ -361,7 +370,7 @@ def _set_cores(cores: int) -> int:
 def shards_grid(
     nodes: int, txs: int, verifier: str, timeout: float, batch: int,
     shard_axis: list, cores: int, repeat: int, probe_timeout: float,
-    bank: bool = True,
+    bank: bool = True, executor: str = "thread",
 ) -> dict:
     """The sharded-plane scaling grid: one firehose per shard count on a
     fixed core budget, best-of-``repeat`` per cell, banked to
@@ -387,7 +396,7 @@ def shards_grid(
         for _ in range(repeat):
             res = asyncio.run(
                 run(nodes, txs, verifier, timeout, batch, obs=False,
-                    shards=shards)
+                    shards=shards, executor=executor)
             )
             if not res["timed_out"]:
                 rates.append(res["committed_tx_per_sec"])
@@ -396,7 +405,7 @@ def shards_grid(
             base_rate = best
         cell = {
             "shards": shards,
-            "executor": "loop" if shards == 1 else "thread",
+            "executor": "loop" if shards == 1 else executor,
             "batch": batch,
             "verifier": verifier,
             "rates": rates,
@@ -412,6 +421,7 @@ def shards_grid(
     peak = max(grid, key=lambda c: c["best_tx_per_sec"])
     summary = {
         "host_cores": host_cores,
+        "executor": executor,
         "shard_axis": shard_axis,
         "best_shards": peak["shards"],
         "best_tx_per_sec": peak["best_tx_per_sec"],
@@ -433,6 +443,11 @@ def shards_grid(
         return {"banked": None, "grid": grid, "summary": summary}
 
     label = "grid_%s_c%d" % (captured_at, host_cores)
+    if executor != "thread":
+        # executor is part of the machine being measured: a process-mode
+        # grid must never overwrite the thread-mode capture of the same
+        # day/core budget (regress.py keys rows by executor too)
+        label += "_" + executor
     doc = {}
     if os.path.exists(SHARDS_BANK_PATH):
         with open(SHARDS_BANK_PATH) as fp:
@@ -461,6 +476,114 @@ def shards_grid(
     return {"banked": label, "grid": grid, "summary": summary}
 
 
+# the owner-loop serial term the fused native drain attacks: frame
+# parse/admission (rx_decode) plus the post-verify quorum/delivery
+# bookkeeping that shares the owner's drain cycle. verify_wait and
+# echo_apply are excluded — the verifier seam and content inserts are
+# not what at2_plane_drain fuses.
+_DRAIN_SERIAL_PHASES = ("rx_decode", "quorum_bitmap", "ready_deliver")
+
+
+def compare_drain(
+    nodes: int, txs: int, verifier: str, timeout: float, batch: int,
+    shards: int, executor: str, repeat: int, bank: bool = True,
+) -> dict:
+    """The drain-fusion phase-accounting A/B (perf_opt proof row):
+    interleave fused runs (at2_plane_drain parses + routes a whole chunk
+    in one GIL-released call) against unfused runs (AT2_NO_PLANE_DRAIN=1
+    — same native per-frame parse, Python routing), phase accounting on,
+    and compare the owner-loop serial term's share of ``plane_total``
+    (rx_decode + quorum_bitmap + ready_deliver). The fused arm's share
+    must come in lower — that delta IS the measured claim banked to
+    BENCH_PLANE_SHARDS.json, not a narrative one."""
+    arms: dict = {"fused": [], "unfused": []}
+    for _ in range(repeat):
+        for arm in ("fused", "unfused"):
+            if arm == "unfused":
+                os.environ["AT2_NO_PLANE_DRAIN"] = "1"
+            try:
+                res = asyncio.run(
+                    run(nodes, txs, verifier, timeout, batch, obs=True,
+                        shards=shards, executor=executor)
+                )
+            finally:
+                os.environ.pop("AT2_NO_PLANE_DRAIN", None)
+            if res["timed_out"]:
+                continue
+            st = res["node0_stats"]
+            serial = sum(
+                st.get(f"phase_{p}_ns", 0) for p in _DRAIN_SERIAL_PHASES
+            )
+            total = st.get("phase_plane_total_ns", 0)
+            arms[arm].append({
+                "tx_per_sec": res["committed_tx_per_sec"],
+                "serial_ns": serial,
+                "plane_total_ns": total,
+                "serial_share": round(serial / total, 4) if total else 0.0,
+            })
+    if not arms["fused"] or not arms["unfused"]:
+        raise RuntimeError("compare-drain: an arm produced no measurement")
+    # best-of-N per arm: the least-perturbed run of each (same convention
+    # as the obs A/B); the share is read from that run, not averaged
+    # across runs with different scheduler luck
+    best_f = max(arms["fused"], key=lambda r: r["tx_per_sec"])
+    best_u = max(arms["unfused"], key=lambda r: r["tx_per_sec"])
+    row = {
+        "config": (
+            "drain-fusion phase delta: owner-loop serial share of "
+            "plane_total, fused (at2_plane_drain) vs unfused "
+            "(AT2_NO_PLANE_DRAIN=1)"
+        ),
+        "nodes": nodes,
+        "submitted": txs,
+        "batch": batch,
+        "shards": shards,
+        "executor": executor,
+        "verifier": verifier,
+        "repeat": repeat,
+        "serial_phases": list(_DRAIN_SERIAL_PHASES),
+        "fused": best_f,
+        "unfused": best_u,
+        "serial_share_delta": round(
+            best_u["serial_share"] - best_f["serial_share"], 4
+        ),
+        "serial_share_reduced": (
+            best_f["serial_share"] < best_u["serial_share"]
+        ),
+        "host_cores": os.cpu_count() or 1,
+        "captured_at": time.strftime("%Y-%m-%d", time.gmtime()),
+    }
+    if bank:
+        doc = {}
+        if os.path.exists(SHARDS_BANK_PATH):
+            with open(SHARDS_BANK_PATH) as fp:
+                doc = json.load(fp)
+        doc.setdefault(
+            "config",
+            "sharded broadcast plane scaling grid: in-process firehose "
+            "tx/s vs shard count at a fixed core budget",
+        )
+        # keep the doc loadable by regress.py even when the phase row is
+        # banked before any scaling grid has run
+        doc.setdefault("runs", {})
+        doc.setdefault("latest", "")
+        label = "drain_%s_c%d_%s" % (
+            row["captured_at"], row["host_cores"], executor
+        )
+        doc.setdefault("phase_accounting", {})[label] = row
+        tmp = SHARDS_BANK_PATH + ".tmp"
+        with open(tmp, "w") as fp:
+            json.dump(doc, fp, indent=1)
+            fp.write("\n")
+        os.replace(tmp, SHARDS_BANK_PATH)
+        print(
+            "banked %s phase_accounting %s" % (SHARDS_BANK_PATH, label),
+            file=sys.stderr,
+        )
+        row["banked"] = label
+    return row
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=3)
@@ -475,6 +598,18 @@ def main(argv=None) -> int:
     ap.add_argument("--shards", type=int, default=1,
                     help="broadcast-plane shard count for a single run "
                          "(1 = the monolithic production default)")
+    ap.add_argument("--executor", default="thread",
+                    choices=("inline", "thread", "process"),
+                    help="sharded-plane executor (shards > 1): thread "
+                         "(one OS thread per shard), process (one spawn "
+                         "worker per shard over shared-memory rings), "
+                         "or inline (synchronous, the sim mode)")
+    ap.add_argument("--compare-drain", action="store_true",
+                    help="phase-accounting A/B: fused at2_plane_drain vs "
+                         "AT2_NO_PLANE_DRAIN=1, banks the owner-loop "
+                         "serial-share delta row to "
+                         "BENCH_PLANE_SHARDS.json; nonzero exit unless "
+                         "the fused arm's share is lower")
     ap.add_argument("--shards-grid", default="",
                     help="comma axis, e.g. 1,2,4: run the firehose per "
                          "shard count and bank the scaling grid to "
@@ -517,6 +652,12 @@ def main(argv=None) -> int:
         result = shards_grid(
             args.nodes, args.txs, args.verifier, args.timeout, args.batch,
             axis, args.cores, args.grid_repeat, args.probe_timeout,
+            bank=not args.no_bank, executor=args.executor,
+        )
+    elif args.compare_drain:
+        result = compare_drain(
+            args.nodes, args.txs, args.verifier, args.timeout, args.batch,
+            max(args.shards, 2), args.executor, args.grid_repeat,
             bank=not args.no_bank,
         )
     elif args.smoke_profile:
@@ -529,7 +670,8 @@ def main(argv=None) -> int:
     else:
         result = asyncio.run(
             run(args.nodes, args.txs, args.verifier, args.timeout,
-                args.batch, obs=args.obs == "on", shards=args.shards)
+                args.batch, obs=args.obs == "on", shards=args.shards,
+                executor=args.executor)
         )
     blob = json.dumps(result, indent=1)
     if args.out == "-":
@@ -543,6 +685,14 @@ def main(argv=None) -> int:
             "profiler smoke failed: "
             + (f"zero phase counters {result['zero_phases']}"
                if result["zero_phases"] else "no folded stacks captured"),
+            file=sys.stderr,
+        )
+        return 1
+    if args.compare_drain and not result["serial_share_reduced"]:
+        print(
+            "drain fusion did not reduce the owner-loop serial share: "
+            f"fused {result['fused']['serial_share']} vs unfused "
+            f"{result['unfused']['serial_share']}",
             file=sys.stderr,
         )
         return 1

@@ -212,7 +212,9 @@ def load_plane_shards(name: str, doc: dict) -> List[dict]:
     """BENCH_PLANE_SHARDS.json: the sharded-plane scaling grid. The
     comparability key carries ``host_cores`` — a 1-core capture and a
     4-core capture of the same shard count measure different things and
-    must never diff against each other."""
+    must never diff against each other — and ``executor`` for the same
+    reason: thread-mode and process-mode rows at the same shard count
+    are different machines (GIL-shared vs separate address spaces)."""
     _require(doc, "config", name)
     runs = _require(doc, "runs", name, dict)
     _require(doc, "latest", name, str)
@@ -226,7 +228,9 @@ def load_plane_shards(name: str, doc: dict) -> List[dict]:
             cores = int(_num(cell, "host_cores", path))
             comp = (
                 f"cores={cores} batch={int(_num(cell, 'batch', path))} "
-                f"verifier={cell.get('verifier')} {_tunnel_tag(cell, run)}"
+                f"verifier={cell.get('verifier')} "
+                f"executor={cell.get('executor', 'thread')} "
+                f"{_tunnel_tag(cell, run)}"
             )
             rows.append(
                 _row(

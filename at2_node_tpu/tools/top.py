@@ -237,13 +237,22 @@ def render_frame(rows, now: float, prev) -> str:
         )
         # broadcast-plane sharding (statusz "plane" block): shard count
         # plus executor initial — "1/l" is the monolithic loop plane,
-        # "4/t" four shard threads (broadcast/shards.py)
+        # "4/t" four shard threads, "4/p" four worker processes
+        # (broadcast/shards.py). A trailing ! counts dropped effect
+        # records (full handoff ring/queue — the plane is shedding), a
+        # trailing X flags crashed shard workers (process mode).
         plane = sz.get("plane", {})
-        shards_s = (
-            f"{_num(plane, 'shards')}/{str(plane.get('executor', '?'))[:1]}"
-            if plane
-            else "-"
-        )
+        if plane:
+            shards_s = (
+                f"{_num(plane, 'shards')}/{str(plane.get('executor', '?'))[:1]}"
+            )
+            eff_drop = _num(plane, "effects_dropped")
+            if eff_drop:
+                shards_s += f"!{eff_drop}"
+            if plane.get("worker_crashed"):
+                shards_s += f"X{len(plane['worker_crashed'])}"
+        else:
+            shards_s = "-"
         lines.append(
             f"{addr:<22}"
             f"{health.get('status', '?'):<11}"

@@ -440,6 +440,58 @@ else
   echo "single-core host: skipping the 2-core scaling smoke"
 fi
 
+echo "== multiprocess-plane gate =="
+# Process executor (ISSUE 17): one spawn worker per shard over
+# shared-memory rings. The determinism half ALWAYS runs: the same seed
+# must produce ONE campaign hash whether [plane] executor says inline,
+# thread, or process — the sim clock forces inline placement, and this
+# sweep pins that seam so a config-dependent code path can never leak
+# into the wire schedule.
+python - <<'EOF'
+from at2_node_tpu.sim.campaign import run_episode
+
+kw = dict(n_events=8, duration=6.0, settle_horizon=45.0)
+for seed in (0, 7):
+    hashes = {}
+    for shards, ex in ((1, "inline"), (4, "inline"), (4, "thread"),
+                       (4, "process")):
+        over = (
+            {"plane_shards": shards, "plane_executor": ex}
+            if shards > 1 else {}
+        )
+        ep = run_episode(seed, config_overrides=over, **kw)
+        assert ep.violations == [], (seed, shards, ex, ep.violations)
+        hashes[(shards, ex)] = ep.trace_hash
+    assert len(set(hashes.values())) == 1, (
+        f"executor observable on the wire at seed {seed}: "
+        + ", ".join(f"{k}={v[:12]}" for k, v in hashes.items())
+    )
+    print(f"seed {seed}: executor-invariant campaign hash "
+          f"{next(iter(hashes.values()))[:16]}")
+EOF
+# 2-core scaling smoke: process-mode shards must buy >= 1.5x plane
+# throughput over the monolithic loop when there are real cores to
+# spread across (this is the whole point of breaking the GIL). A
+# 1-core host cannot measure scaling — skip, same policy as the
+# thread-mode smoke above.
+if [ "$(nproc)" -ge 2 ]; then
+  python -m at2_node_tpu.tools.plane_bench --shards-grid 1,2 --cores 2 \
+      --executor process --nodes 3 --txs 300 --grid-repeat 2 --no-bank \
+      --out /tmp/_plane_process_smoke.json
+  python - <<'EOF'
+import json
+
+doc = json.load(open("/tmp/_plane_process_smoke.json"))
+speedup = doc["summary"]["peak_speedup_vs_1"]
+assert speedup >= 1.5, (
+    f"process-mode plane speedup {speedup}x < 1.5x on 2 cores"
+)
+print(f"process plane 2-core speedup: {speedup}x")
+EOF
+else
+  echo "single-core host: skipping the process-mode scaling smoke"
+fi
+
 echo "== bench-regression sentry gate =="
 # regress.py diffs every banked BENCH_*/SCALE_*/MULTICHIP_* artifact
 # against its nearest COMPARABLE capture (tunnel/device state must

@@ -350,3 +350,310 @@ class TestShardedCrashRestart:
             assert net.services[2].recovery.state == "live"
         finally:
             net.close()
+
+
+# ---------------------------------------------------------------------------
+# shared-memory ring (parallel/ring.py): the process-mode handoff lane
+
+
+class TestShmRing:
+    def _mk(self, name, **kw):
+        from at2_node_tpu.parallel.ring import ShmRing
+
+        return ShmRing(name, create=True, **kw)
+
+    def test_roundtrip_and_wrap_preserves_order(self):
+        import os as _os
+
+        ring = self._mk(f"at2t-{_os.getpid()}-wrap", slots=16, slot_bytes=32)
+        try:
+            rng = random.Random(5)
+            # far more traffic than the ring holds at once: every record
+            # crosses the wrap boundary many times, sizes span 1 slot to
+            # several, and order must survive exactly
+            for batch in range(100):
+                sent = []
+                for i in range(rng.randrange(1, 4)):
+                    payload = bytes(
+                        rng.randrange(256) for _ in range(rng.randrange(0, 40))
+                    )
+                    kind = 1 + (batch + i) % 7
+                    assert ring.put(kind, payload)
+                    sent.append((kind, payload))
+                got, worst = ring.drain()
+                assert got == sent
+                assert worst >= 0
+            assert ring.dropped == 0
+        finally:
+            ring.close()
+
+    def test_full_ring_drops_with_producer_accounting(self):
+        import os as _os
+
+        ring = self._mk(f"at2t-{_os.getpid()}-full", slots=4, slot_bytes=32)
+        try:
+            # each 20-byte payload needs ceil((16+20)/32) = 2 slots
+            assert ring.put(1, b"x" * 20)
+            assert ring.put(2, b"y" * 20)
+            assert len(ring) == 4
+            # full: refused WITHOUT blocking and WITHOUT overwriting
+            assert not ring.put(3, b"z" * 20)
+            assert ring.dropped == 1
+            assert not ring.put(3, b"z" * 20)
+            assert ring.dropped == 2
+            # a record larger than the whole ring can never fit
+            assert not ring.put(4, b"w" * 4096)
+            assert ring.dropped == 3
+            # draining frees capacity; the drop counter is cumulative
+            got, _ = ring.drain()
+            assert [k for k, _p in got] == [1, 2]
+            assert ring.put(5, b"q" * 20)
+            got, _ = ring.drain()
+            assert got == [(5, b"q" * 20)]
+            assert ring.dropped == 3
+        finally:
+            ring.close()
+
+    def test_stale_segment_reclaimed_on_create(self):
+        import os as _os
+
+        from at2_node_tpu.parallel.ring import ShmRing
+
+        name = f"at2t-{_os.getpid()}-stale"
+        dead = self._mk(name, slots=8, slot_bytes=32)
+        dead.put(1, b"predecessor state")
+        # simulate an owner that died uncleanly: detach WITHOUT unlink,
+        # leaving the segment (and its queued record) in /dev/shm
+        dead._owner = False
+        dead.close()
+        # an owner restart creating the same name must reclaim the stale
+        # segment and start empty — never attach to predecessor state
+        reborn = ShmRing(name, slots=8, slot_bytes=32, create=True)
+        try:
+            assert len(reborn) == 0
+            assert reborn.drain() == ([], 0)
+            assert reborn.put(2, b"fresh")
+            assert reborn.drain()[0] == [(2, b"fresh")]
+        finally:
+            reborn.close()
+
+
+# ---------------------------------------------------------------------------
+# process-mode state protocol: the counter vocabulary must stay aligned
+
+
+class TestWorkerStatKeys:
+    def test_stat_keys_exist_in_both_counter_groups(self):
+        """E_STATS records are positional u64 deltas in STAT_KEYS order;
+        a key that drifts out of either counter group would silently
+        misattribute every shard worker's counters."""
+        import types
+
+        from at2_node_tpu.broadcast.stack import Broadcast
+        from at2_node_tpu.parallel.plane_worker import STAT_KEYS
+
+        kp = SignKeyPair.random()
+        mesh = types.SimpleNamespace(peers=[], by_sign={})
+        plane = ShardedPlane(kp, mesh, None, shards=2, executor="inline")
+        core = Broadcast(kp, mesh, None, workers=0)
+        assert len(STAT_KEYS) == len(set(STAT_KEYS))
+        for key in STAT_KEYS:
+            plane.stats[key]  # raises KeyError on drift
+            core.stats[key]
+
+
+# ---------------------------------------------------------------------------
+# native one-call drain: parse + shard routing must match the Python path
+
+
+class TestNativePlaneDrain:
+    def _mixed_frames(self, rng, n=64):
+        from at2_node_tpu.broadcast.messages import (
+            Attestation,
+            ContentRequest,
+            ECHO,
+            READY,
+        )
+
+        frames, msgs = [], []
+        senders = [SignKeyPair.random() for _ in range(4)]
+        origin = SignKeyPair.random()
+        for i in range(n):
+            pick = rng.randrange(3)
+            if pick == 0:
+                m = make_payload(senders[i % 4], seq=i + 1)
+            elif pick == 1:
+                phase = ECHO if i % 2 else READY
+                chash = bytes(rng.randrange(256) for _ in range(32))
+                sender = senders[i % 4].public
+                sig = origin.sign(
+                    Attestation.signing_bytes(phase, sender, i + 1, chash)
+                )
+                m = Attestation(phase, origin.public, sender, i + 1, chash, sig)
+            else:
+                m = ContentRequest(
+                    senders[i % 4].public,
+                    i + 1,
+                    bytes(rng.randrange(256) for _ in range(32)),
+                )
+            msgs.append(m)
+            frames.append(m.encode())
+        return frames, msgs
+
+    def test_routing_matches_python_shard_of(self):
+        from at2_node_tpu.native import ingest_available, plane_drain_native
+
+        if not ingest_available():
+            pytest.skip("native ingest kernels not built on this host")
+        rng = random.Random(17)
+        frames, msgs = self._mixed_frames(rng)
+        for shards in (1, 2, 4):
+            items, frame_ok, counts = plane_drain_native(frames, shards)
+            assert len(items) == len(frames)
+            assert all(frame_ok)
+            tally = [0] * shards
+            for fidx, sid, msg in items:
+                # every message kind here routes by the slot's sender key
+                assert sid == shard_of(msgs[fidx].sender, shards)
+                assert type(msg) is type(msgs[fidx])
+                assert msg.encode() == frames[fidx]
+                tally[sid] += 1
+            assert list(counts) == tally
+            if shards > 1:
+                assert len([t for t in tally if t]) > 1, "routing collapsed"
+
+    def test_want_objects_false_wire_roundtrip(self):
+        """Process-mode dispatch ships raw wire bytes to workers; the
+        reconstructed per-message frames must be byte-identical to the
+        originals (the worker re-parses them)."""
+        from at2_node_tpu.native import ingest_available, plane_drain_native
+
+        if not ingest_available():
+            pytest.skip("native ingest kernels not built on this host")
+        rng = random.Random(23)
+        frames, _msgs = self._mixed_frames(rng, n=48)
+        items, frame_ok, _counts = plane_drain_native(
+            frames, 4, want_objects=False
+        )
+        assert all(frame_ok)
+        objs, _, _ = plane_drain_native(frames, 4)
+        assert len(items) == len(objs)
+        for (fidx, sid, kind, wire), (ofidx, osid, _msg) in zip(items, objs):
+            assert (fidx, sid) == (ofidx, osid)
+            assert wire == frames[fidx]
+            assert wire[0] == kind
+
+
+# ---------------------------------------------------------------------------
+# tentpole: multiprocess plane over real services — delivery, crash
+# detection, degraded health with shard attribution, clean shutdown
+
+
+class TestProcessPlaneE2E:
+    @pytest.mark.asyncio
+    async def test_process_executor_delivers_then_survives_worker_crash(self):
+        from at2_node_tpu.parallel import plane_worker as pw
+
+        cfgs = make_net_configs(
+            3, _ports, plane=PlaneConfig(shards=2, executor="process")
+        )
+        services = [await Service.start(c) for c in cfgs]
+        try:
+            for svc in services:
+                assert isinstance(svc.broadcast, ShardedPlane)
+                info = svc.broadcast.plane_info()
+                assert info["executor"] == "process"
+                assert all(
+                    svc.broadcast._executor.alive(sid) for sid in range(2)
+                )
+
+            # enough distinct senders that both shards carry slots
+            senders = [SignKeyPair.random() for _ in range(4)]
+            n_tx = 0
+            for sender in senders:
+                for seq in (1, 2):
+                    await services[0].broadcast.broadcast(
+                        make_payload(sender, seq=seq)
+                    )
+                    n_tx += 1
+            async def all_committed():
+                return all(s.committed >= n_tx for s in services)
+
+            await wait_until(
+                all_committed,
+                timeout=60.0,
+                what="all payloads commit through the process plane",
+            )
+            assert {shard_of(s.public, 2) for s in senders} == {0, 1}
+
+            # kill shard 0's worker on node 0 mid-flight (C_EXIT is the
+            # crash-injection record; exit code 7 must surface verbatim)
+            victim = services[0]
+            victim.broadcast._executor.actions[0].put(pw.C_EXIT, bytes([7]))
+
+            async def crash_seen():
+                return victim.broadcast.worker_crashed == {0: 7}
+
+            await wait_until(
+                crash_seen,
+                timeout=30.0,
+                what="owner detects the dead worker",
+            )
+            # degraded — never hung — with shard attribution everywhere
+            # an operator looks: /healthz, /statusz plane block, and a
+            # flight-recorder snapshot for the post-mortem
+            hv = victim.health_verdict()
+            assert hv["status"] == "degraded"
+            assert hv["plane_workers_crashed"] == {"0": 7}
+            assert victim.broadcast.plane_info()["worker_crashed"] == {"0": 7}
+            assert any(
+                s["reason"].startswith("plane_worker_crash:shard=0")
+                for s in victim.recorder.dump()["snapshots"]
+            )
+            # the other shard's worker is untouched and the crash is
+            # reported exactly once
+            assert victim.broadcast._executor.alive(1)
+            assert victim.broadcast._executor.poll_crashed() == []
+            # healthy nodes stay healthy
+            assert services[1].health_verdict()["status"] == "ok"
+        finally:
+            for s in services:
+                await s.close()
+        # clean shutdown reaps every worker process and unlinks the rings
+        for svc in services:
+            ex = svc.broadcast._executor
+            assert all(not p.is_alive() for p in ex._procs)
+            assert ex.actions == [] and ex.effects == []
+
+
+# ---------------------------------------------------------------------------
+# tentpole gate: the configured executor must be unobservable on the wire
+
+
+class TestExecutorHashSweep:
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_campaign_hash_identical_across_executors(self, seed):
+        """`[plane] executor` is a RUNTIME placement choice, never a
+        protocol change: under the sim clock the service forces inline
+        execution whatever the config says, so one monolithic episode
+        and three sharded episodes configured inline/thread/process must
+        produce the identical wire-trace hash. This is the seam the CI
+        multiprocess-plane gate pins."""
+        kw = dict(n_events=8, duration=6.0, settle_horizon=45.0)
+        mono = run_episode(seed, **kw)
+        assert mono.violations == []
+        hashes = {"mono1": mono.trace_hash}
+        for ex in ("inline", "thread", "process"):
+            ep = run_episode(
+                seed,
+                config_overrides={
+                    "plane_shards": SHARDS,
+                    "plane_executor": ex,
+                },
+                **kw,
+            )
+            assert ep.violations == []
+            assert ep.committed == mono.committed
+            assert ep.delivered == mono.delivered
+            hashes[ex] = ep.trace_hash
+        assert len(set(hashes.values())) == 1, hashes
