@@ -45,6 +45,7 @@ import json
 import logging
 import os
 import struct
+from collections import deque
 from typing import Dict, List, Optional
 
 from ..parallel import plane_worker as pw
@@ -175,6 +176,10 @@ class ShardedPlane:
         overlap_ready: bool = False,
         ring_slots: int = 4096,
         ring_slot_bytes: int = 1024,
+        worker_profiler: bool = True,
+        profiler_hz: float = 97.0,
+        profiler_max_nodes: int = 20000,
+        obs_flush_s: float = 0.05,
     ) -> None:
         from ..clock import SYSTEM_CLOCK
         from ..obs.registry import Registry
@@ -211,6 +216,34 @@ class ShardedPlane:
         self.worker_crashed: Dict[int, int] = {}
         self.on_worker_crash = None  # service hook: (shard_id, exitcode)
         self._pending_wm_restore: list = []
+
+        # obs shipping lane (process mode): each worker runs its own
+        # diagnosis-tier slice and streams delta records over a dedicated
+        # per-shard obs ring; the owner folds them into THIS registry so
+        # /metrics, /statusz, /profilez, /debugz see through the process
+        # boundary. The lane exists whenever any instrument that would
+        # ride it is enabled (worker gating mirrors the owner's).
+        self._obs_ship = self._proc and (
+            recorder is not None
+            or trace is not None
+            or phases is not None
+            or worker_profiler
+        )
+        self._worker_profiler = worker_profiler
+        self._profiler_hz = profiler_hz
+        self._profiler_max_nodes = profiler_max_nodes
+        self._obs_flush_s = obs_flush_s
+        # per-shard fold state: raw phase-ns vectors (post-mortem + the
+        # *_shardN counters derive from these increments), recorder
+        # event tails, and folded-stack increments for /profilez merges
+        self._obs_phase_ns: List[Dict[str, int]] = [
+            dict() for _ in range(shards)
+        ]
+        self._obs_worker_events: List[deque] = [
+            deque(maxlen=2048) for _ in range(shards)
+        ]
+        self._obs_folds: List[Dict[str, int]] = [dict() for _ in range(shards)]
+        self._obs_fold_samples = [0] * shards
 
         # one effects lane per shard (only drained in threaded mode, but
         # constructed always so instruments exist and stay cheap)
@@ -326,6 +359,13 @@ class ShardedPlane:
             "(producer-side drop accounting; should be 0)",
             fn=lambda: float(self.effects_dropped),
         )
+        self.registry.gauge(
+            "obs_records_dropped",
+            "observability delta records shed at obs-ring capacity "
+            "(accounted loss, never backpressure; distinct from "
+            "plane_shard_effects_dropped)",
+            fn=lambda: float(self.obs_dropped),
+        )
         self._handoff_hist = self.registry.histogram(
             "plane_shard_handoff_ns",
             "shard effect enqueue-to-apply latency (ns)",
@@ -410,12 +450,14 @@ class ShardedPlane:
             self._executor.stop_workers()
             try:
                 self._flush_proc_effects()
+                self._flush_proc_obs()
             except Exception:  # pragma: no cover - teardown best-effort
                 pass
         self._executor.shutdown()
 
     def _make_worker_spec(
-        self, sid: int, actions_ring: str, effects_ring: str
+        self, sid: int, actions_ring: str, effects_ring: str,
+        obs_ring: str = "",
     ) -> WorkerSpec:
         return WorkerSpec(
             shard_id=sid,
@@ -433,6 +475,19 @@ class ShardedPlane:
             ring_slots=self._executor.ring_slots,
             ring_slot_bytes=self._executor.ring_slot_bytes,
             parent_pid=os.getpid(),
+            # worker obs slice: gated by the SAME instruments the owner
+            # runs, so thread-mode and process-mode observability agree
+            obs_ring=obs_ring if self._obs_ship else "",
+            recorder_cap=(
+                self.recorder._cap if self.recorder is not None else 0
+            ),
+            trace_sample=(
+                self.trace._sample_every if self.trace is not None else 0
+            ),
+            phase_accounting=self.phases is not None,
+            profiler_hz=self._profiler_hz,
+            profiler_max_nodes=self._profiler_max_nodes,
+            obs_flush_s=self._obs_flush_s,
         )
 
     # -- ingress (mirrors Broadcast.on_frame admission exactly) -----------
@@ -723,6 +778,7 @@ class ShardedPlane:
         while True:
             try:
                 n = self._flush_proc_effects()
+                n += self._flush_proc_obs()
                 self._poll_workers()
             except Exception:
                 logger.exception("plane effects flush error")
@@ -778,6 +834,161 @@ class ShardedPlane:
             self._handoff_hist.observe(worst)
         return total
 
+    # -- obs shipping lane: owner-side fold ------------------------------
+
+    def _flush_proc_obs(self) -> int:
+        """Drain every worker's obs ring and fold the delta records into
+        the owner's registry / tracer / event tails. Returns the number
+        of records folded (feeds the flusher's adaptive cadence)."""
+        if not self._obs_ship or not self._executor._started:
+            return 0
+        total = 0
+        for sid in range(len(self._executor.obs)):
+            total += self._drain_obs_ring(sid)
+        return total
+
+    def _drain_obs_ring(self, sid: int) -> int:
+        recs, _ = self._executor.obs[sid].drain()
+        for kind, payload in recs:
+            try:
+                self._apply_obs_record(sid, kind, payload)
+            except Exception:
+                logger.exception("obs record fold error (shard %d)", sid)
+        return len(recs)
+
+    def _apply_obs_record(self, sid: int, kind: int, payload: bytes) -> None:
+        from ..obs.profiler import (
+            PHASE_BOUNDS,
+            PHASES,
+            PLANE_LEAF_PHASES,
+            parse_folded,
+        )
+
+        if kind == pw.O_PHASE:
+            # Fold rules mirror thread-mode ShardPhaseView: leaf phases
+            # dual-write base + shardN; slot_gc (and any other non-leaf
+            # a worker marks) goes to base only; plane_total goes ONLY
+            # to its shardN counter — the worker's drain-cycle span and
+            # the owner's dispatch span are DIFFERENT denominators, and
+            # profile_collect sums them explicitly.
+            head, nb = pw._ophase, len(PHASE_BOUNDS) + 1
+            step = head.size + 4 * nb
+            for off in range(0, len(payload), step):
+                idx, ns, count, sum_s, max_s = head.unpack_from(payload, off)
+                if idx >= len(PHASES):
+                    continue  # vocabulary drift: shed rather than crash
+                phase = PHASES[idx]
+                buckets = struct.unpack_from(f"<{nb}I", payload, off + head.size)
+                acc = self._obs_phase_ns[sid]
+                acc[phase] = acc.get(phase, 0) + ns
+                if phase == "plane_total":
+                    self.registry.counter(
+                        f"phase_plane_total_shard{sid}_ns",
+                        "elapsed ns of plane shard worker drain cycles "
+                        f"(shard {sid} process)",
+                    ).inc(ns)
+                    continue
+                self.registry.counter(f"phase_{phase}_ns").inc(ns)
+                if phase in PLANE_LEAF_PHASES:
+                    self.registry.counter(
+                        f"phase_{phase}_shard{sid}_ns",
+                        f"elapsed ns accounted to phase {phase} on plane "
+                        f"shard {sid}",
+                    ).inc(ns)
+                self.registry.histogram(
+                    f"phase_{phase}", bounds=PHASE_BOUNDS
+                ).merge_deltas(buckets, sum_s, count, max_s)
+        elif kind == pw.O_REC:
+            events = json.loads(payload.decode())
+            self._obs_worker_events[sid].extend(events)
+        elif kind == pw.O_TRACE:
+            if self.trace is None:
+                return
+            rec = pw._otrace
+            for off in range(0, len(payload), rec.size):
+                sender, seq, stage_idx, mono = rec.unpack_from(payload, off)
+                if stage_idx < len(pw.TRACE_STAGES):
+                    self.trace.stamp(
+                        (sender, seq), pw.TRACE_STAGES[stage_idx], now=mono
+                    )
+        elif kind == pw.O_FOLD:
+            samples = int.from_bytes(payload[:8], "little")
+            self._obs_fold_samples[sid] += samples
+            fold = self._obs_folds[sid]
+            for stack, count in parse_folded(payload[8:].decode()).items():
+                fold[stack] = fold.get(stack, 0) + count
+
+    @property
+    def obs_dropped(self) -> int:
+        """Producer-side drops on the obs lane only — exported as
+        ``obs_records_dropped``, deliberately OUTSIDE
+        ``plane_shard_effects_dropped`` (losing a phase delta is an
+        observability gap; losing an effect record is protocol loss)."""
+        if not self._proc or not self._executor._started:
+            return 0
+        total = 0
+        for ring in self._executor.obs:
+            try:
+                total += ring.dropped
+            except Exception:  # pragma: no cover - ring torn down
+                pass
+        return total
+
+    def worker_events(self) -> list:
+        """Worker-side recorder events shipped over the obs lane, in the
+        /debugz event shape with codes prefixed ``shardN/``, sorted by
+        mono timestamp (one CLOCK_MONOTONIC machine-wide, so they
+        interleave truthfully with owner events)."""
+        out = []
+        for sid, dq in enumerate(self._obs_worker_events):
+            pre = f"shard{sid}/"
+            out.extend([t, pre + code, detail] for t, code, detail in dq)
+        out.sort(key=lambda e: e[0])
+        return out
+
+    def profiler_start(self, duration: Optional[float] = None) -> bool:
+        """Fan a StackSampler start to every worker (C_PROF) and reset
+        the owner-side fold accumulators, so a /profilez session reports
+        only its own window. Returns True if the fan-out happened."""
+        if not (
+            self._obs_ship
+            and self._worker_profiler
+            and self._executor._started
+        ):
+            return False
+        for sid in range(self.shards):
+            self._obs_folds[sid] = {}
+            self._obs_fold_samples[sid] = 0
+        payload = pw._prof.pack(1, float(duration if duration else 0.0))
+        for ring in self._executor.actions:
+            ring.put(pw.C_PROF, payload)
+        return True
+
+    def profiler_stop(self) -> bool:
+        if not (
+            self._obs_ship
+            and self._worker_profiler
+            and self._executor._started
+        ):
+            return False
+        payload = pw._prof.pack(0, 0.0)
+        for ring in self._executor.actions:
+            ring.put(pw.C_PROF, payload)
+        return True
+
+    def worker_folds(self) -> list:
+        """``(prefix, {stack: count})`` parts for
+        :func:`~..obs.profiler.merge_folded` — one per shard that has
+        shipped folded-stack increments."""
+        return [
+            (f"shard{sid}/", dict(self._obs_folds[sid]))
+            for sid in range(self.shards)
+            if self._obs_folds[sid]
+        ]
+
+    def worker_fold_samples(self) -> int:
+        return sum(self._obs_fold_samples)
+
     def _poll_workers(self) -> None:
         """Surface worker deaths exactly once each: crash ledger for
         /healthz attribution, flight-recorder code, service hook. The
@@ -789,10 +1000,27 @@ class ShardedPlane:
             logger.error(
                 "plane shard %d worker died (exit %s)", sid, code
             )
+            extra = None
+            if self._obs_ship:
+                # post-mortem: the dead worker can't flush again, but
+                # whatever it already shipped is still in shared memory
+                # — drain it FIRST so the crash snapshot carries the
+                # worker's last recorder events and phase totals
+                try:
+                    self._drain_obs_ring(sid)
+                except Exception:
+                    logger.exception("post-mortem obs drain failed")
+                extra = {
+                    "shard": sid,
+                    "exit": code,
+                    "recorder_tail": list(self._obs_worker_events[sid])[-64:],
+                    "phases": dict(self._obs_phase_ns[sid]),
+                }
             if self.recorder is not None:
                 try:
                     self.recorder.snapshot(
-                        f"plane_worker_crash:shard={sid},exit={code}"
+                        f"plane_worker_crash:shard={sid},exit={code}",
+                        extra=extra,
                     )
                 except Exception:
                     logger.exception("crash snapshot failed")
@@ -982,6 +1210,8 @@ class ShardedPlane:
             "executor": self._executor.name,
             "effects_dropped": self.effects_dropped,
         }
+        if self._proc:
+            info["obs_records_dropped"] = self.obs_dropped
         if self.worker_crashed:
             info["worker_crashed"] = {
                 str(sid): code for sid, code in self.worker_crashed.items()

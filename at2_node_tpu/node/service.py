@@ -572,6 +572,9 @@ class Service(At2Servicer):
                     clock=service.clock,
                     phases=service.phases,
                     overlap_ready=config.wan.overlap_ready,
+                    worker_profiler=config.observability.profilez,
+                    profiler_hz=config.observability.profiler_hz,
+                    profiler_max_nodes=config.observability.profiler_max_nodes,
                 )
             else:
                 service.broadcast = Broadcast(
@@ -1284,6 +1287,7 @@ class Service(At2Servicer):
         carries the whole plane decomposition input)."""
         params = params or {}
         obs = self.config.observability
+        plane = self._plane_obs()
         if "start" in params:
             try:
                 duration = float(
@@ -1293,13 +1297,22 @@ class Service(At2Servicer):
                 duration = obs.profiler_duration
             self.sampler.reset()
             started = self.sampler.start(duration=duration)
+            workers = (
+                plane.profiler_start(duration) if plane is not None else False
+            )
             body = json.dumps(
-                {"started": started, **self.sampler.stats()},
+                {
+                    "started": started,
+                    "workers_started": workers,
+                    **self.sampler.stats(),
+                },
                 sort_keys=True, default=float,
             ).encode()
             return 200, self._OBS_JSON, body
         if "stop" in params:
             self.sampler.stop()
+            if plane is not None:
+                plane.profiler_stop()
             body = json.dumps(
                 {"stopped": True, **self.sampler.stats()},
                 sort_keys=True, default=float,
@@ -1312,14 +1325,17 @@ class Service(At2Servicer):
             except ValueError:
                 pass
         if params.get("fmt") == "folded":
-            body = self.sampler.folded(limit).encode()
+            body = self._merged_folded(plane, limit).encode()
             return 200, "text/plain; charset=utf-8", body
-        folded = self.sampler.folded(limit)
+        folded = self._merged_folded(plane, limit)
+        sampler_stats = self.sampler.stats()
+        if plane is not None:
+            sampler_stats["worker_samples"] = plane.worker_fold_samples()
         body = json.dumps(
             {
                 "node": self.config.sign_key.public.hex()[:16],
                 "build": self.build_block(),
-                "sampler": self.sampler.stats(),
+                "sampler": sampler_stats,
                 "phases": (
                     self.phases.totals() if self.phases is not None else {}
                 ),
@@ -1329,6 +1345,27 @@ class Service(At2Servicer):
             sort_keys=True, default=float,
         ).encode()
         return 200, self._OBS_JSON, body
+
+    def _plane_obs(self):
+        """The sharded plane, iff it runs the process-mode obs shipping
+        lane (otherwise the single-interpreter surfaces are complete on
+        their own and nothing needs merging)."""
+        b = self.broadcast
+        if b is not None and getattr(b, "_obs_ship", False):
+            return b
+        return None
+
+    def _merged_folded(self, plane, limit: int | None) -> str:
+        """Owner folded stacks merged with every shard worker's shipped
+        increments, worker frames prefixed ``shardN/``. With no obs lane
+        this is exactly the owner sampler's folded() output."""
+        if plane is None:
+            return self.sampler.folded(limit)
+        from ..obs.profiler import merge_folded
+
+        parts = [("", self.sampler.folded())]
+        parts.extend(plane.worker_folds())
+        return merge_folded(parts, limit)
 
     def tracez(self, limit: int | None = None) -> dict:
         """Live + completed lifecycle traces plus a paired clock reading
@@ -1344,10 +1381,23 @@ class Service(At2Servicer):
         }
 
     def debugz(self) -> dict:
-        """The flight recorder's ring + anomaly snapshots."""
+        """The flight recorder's ring + anomaly snapshots. In process
+        mode, shard workers' shipped recorder events are interleaved
+        into the event list by mono timestamp (codes are ``shardN/``-
+        prefixed), so one dump reads as one fleet-of-processes
+        timeline."""
+        dump = self.recorder.dump()
+        plane = self._plane_obs()
+        if plane is not None:
+            worker_events = plane.worker_events()
+            if worker_events:
+                dump["worker_events"] = len(worker_events)
+                dump["events"] = sorted(
+                    dump["events"] + worker_events, key=lambda e: e[0]
+                )
         return {
             "node": self.config.sign_key.public.hex()[:16],
-            "recorder": self.recorder.dump(),
+            "recorder": dump,
         }
 
     def health_verdict(self) -> dict:

@@ -59,6 +59,8 @@ __all__ = [
     "ShardPhaseView",
     "StackSampler",
     "build_info",
+    "merge_folded",
+    "parse_folded",
 ]
 
 # The fixed phase vocabulary. ``plane_total`` wraps a broadcast worker's
@@ -495,6 +497,50 @@ class StackSampler:
         for child in node.children.values():
             total += StackSampler._subtree_count(child)
         return total
+
+
+def parse_folded(text: str) -> dict[str, int]:
+    """Collapsed-stack text -> ``{stack: count}``. Tolerant of blank
+    lines; a malformed line (no trailing integer) is skipped rather than
+    poisoning the merge — folded increments cross a process boundary."""
+    out: dict[str, int] = {}
+    for line in text.splitlines():
+        stack, _, count = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            n = int(count)
+        except ValueError:
+            continue
+        out[stack] = out.get(stack, 0) + n
+    return out
+
+
+def merge_folded(
+    parts: Iterable[tuple[str, "str | dict[str, int]"]],
+    limit: Optional[int] = None,
+) -> str:
+    """Merge several collapsed-stack profiles into one folded text.
+
+    ``parts`` is ``(prefix, folded)`` pairs where ``folded`` is either
+    folded text or an already-parsed ``{stack: count}`` dict; a
+    non-empty prefix is prepended to every stack in that part (the
+    multi-process convention: worker frames arrive as ``shardN/...``).
+    Ordering matches :meth:`StackSampler.folded`: count descending,
+    then stack string — deterministic for identical inputs.
+    """
+    agg: dict[str, int] = {}
+    for prefix, folded in parts:
+        entries = (
+            parse_folded(folded) if isinstance(folded, str) else folded
+        )
+        for stack, count in entries.items():
+            key = f"{prefix}{stack}" if prefix else stack
+            agg[key] = agg.get(key, 0) + count
+    lines = sorted(agg.items(), key=lambda kv: (-kv[1], kv[0]))
+    if limit is not None:
+        lines = lines[:limit]
+    return "\n".join(f"{stack} {count}" for stack, count in lines)
 
 
 # --------------------------------------------------------------------------

@@ -87,23 +87,42 @@ class FlightRecorder:
             self._ring.append((t, code, detail))
             self._total += 1
 
-    def snapshot(self, reason: str) -> None:
+    def events_since(self, n: int) -> tuple[list, int]:
+        """Events whose total-counter position is ``> n``, formatted, plus
+        the new total. The delta-export primitive for shipping recorder
+        events out of a worker process: the caller remembers the returned
+        total and passes it back next time. Events that rolled out of the
+        ring between calls are simply gone (same loss contract as the
+        ring itself)."""
+        with self._lock:
+            total = self._total
+            missing = total - n
+            if missing <= 0:
+                return [], total
+            take = min(missing, len(self._ring))
+            events = [self._fmt(e) for e in list(self._ring)[-take:]]
+        return events, total
+
+    def snapshot(self, reason: str, extra: dict | None = None) -> None:
         """Freeze the current ring under ``reason`` (anomaly capture).
         The frozen copy survives ring rollover; at most ``max_snapshots``
-        newest snapshots are kept."""
+        newest snapshots are kept. ``extra`` attaches an arbitrary
+        forensic payload (e.g. a dead worker's post-mortem drain) to the
+        frozen copy."""
         if not self._cap:
             return
         now_m = self._clock.monotonic()
         now_w = self._clock.wall()
         with self._lock:
-            self._snapshots.append(
-                {
-                    "reason": reason,
-                    "now_monotonic": round(now_m, 9),
-                    "now_wall": round(now_w, 9),
-                    "events": [self._fmt(e) for e in self._ring],
-                }
-            )
+            snap = {
+                "reason": reason,
+                "now_monotonic": round(now_m, 9),
+                "now_wall": round(now_w, 9),
+                "events": [self._fmt(e) for e in self._ring],
+            }
+            if extra is not None:
+                snap["extra"] = extra
+            self._snapshots.append(snap)
             self._snapshots_taken += 1
 
     @staticmethod

@@ -196,15 +196,16 @@ class ProcessPlaneExecutor:
         self.ring_slot_bytes = ring_slot_bytes
         self.actions: List[ShmRing] = []
         self.effects: List[ShmRing] = []
+        self.obs: List[ShmRing] = []
         self._procs: list = []
         self._crashed: dict = {}  # sid -> exitcode, reported once
         self._closing = False
         self._started = False
 
-    def start(self, make_spec: Callable[[int, str, str], Any]) -> None:
+    def start(self, make_spec: Callable[[int, str, str, str], Any]) -> None:
         """Create the rings, then spawn one worker per shard.
-        ``make_spec(shard_id, actions_ring, effects_ring)`` builds the
-        picklable spec (broadcast/shards.py supplies it)."""
+        ``make_spec(shard_id, actions_ring, effects_ring, obs_ring)``
+        builds the picklable spec (broadcast/shards.py supplies it)."""
         if self._started:
             return
         self._started = True
@@ -220,12 +221,20 @@ class ProcessPlaneExecutor:
                 f"{base}-e{sid}", slots=self.ring_slots,
                 slot_bytes=self.ring_slot_bytes, create=True,
             ))
+            # dedicated observability lane (worker -> owner): phase /
+            # recorder / trace / folded-stack delta records must never
+            # compete with protocol effects for ring capacity
+            self.obs.append(ShmRing(
+                f"{base}-o{sid}", slots=self.ring_slots,
+                slot_bytes=self.ring_slot_bytes, create=True,
+            ))
         ctx = multiprocessing.get_context("spawn")
         for sid in range(self.shards):
             proc = ctx.Process(
                 target=worker_main,
                 args=(make_spec(
-                    sid, self.actions[sid].name, self.effects[sid].name
+                    sid, self.actions[sid].name, self.effects[sid].name,
+                    self.obs[sid].name,
                 ),),
                 daemon=True,
                 name=f"plane-shard-{sid}",
@@ -281,10 +290,11 @@ class ProcessPlaneExecutor:
 
     def shutdown(self) -> None:
         self.stop_workers()
-        for ring in (*self.actions, *self.effects):
+        for ring in (*self.actions, *self.effects, *self.obs):
             ring.close()
         self.actions = []
         self.effects = []
+        self.obs = []
 
 
 def make_plane_executor(

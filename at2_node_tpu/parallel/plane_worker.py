@@ -43,7 +43,7 @@ import time
 from dataclasses import dataclass
 from typing import Tuple
 
-__all__ = ["WorkerSpec", "worker_main", "STAT_KEYS"]
+__all__ = ["WorkerSpec", "worker_main", "STAT_KEYS", "TRACE_STAGES"]
 
 # owner -> worker control/message record kinds (ShmRing `kind` byte)
 C_MSG = 1  # peer_sign(32) + one-message wire frame
@@ -53,6 +53,7 @@ C_THRESH = 4  # u32 echo_threshold, u32 ready_threshold
 C_WM_RESTORE = 5  # JSON watermark doc (floors fan-in)
 C_RELEASE = 6  # sender(32) + u64 sequence (entry-registry release)
 C_EXIT = 7  # u8 exit code: simulate a worker crash (tests only)
+C_PROF = 8  # u8 start(1)/stop(0) + f64 duration (<=0 = until stopped)
 
 # worker -> owner effect record kinds
 E_SEND = 16  # peer_sign(32) + frame
@@ -62,6 +63,27 @@ E_STALL = 19  # empty
 E_STATS = 20  # len(STAT_KEYS) * u64 counter deltas, STAT_KEYS order
 E_WM = 21  # u8 plane (0=tx 1=batch) + key(32) + u64 sequence
 E_INFO = 22  # u32 undelivered + u64 floor_refusals
+
+# worker -> owner OBSERVABILITY record kinds. These ride a dedicated
+# per-shard obs ring, never the effects ring: a firehose of phase deltas
+# must not evict protocol frames, and an obs drop is a separate budget
+# (`obs_records_dropped`) from `plane_shard_effects_dropped`.
+O_PHASE = 32  # repeated per-changed-phase delta records (see _ophase)
+O_REC = 33  # JSON [[t, code, [detail...]], ...] recorder event increments
+O_TRACE = 34  # repeated sender(32) + u64 seq + u8 stage idx + f64 mono
+O_FOLD = 35  # u64 sample-tick delta + folded-stack text increments
+
+# The TxTrace stages a Broadcast core stamps, in wire order for O_TRACE
+# records. Owner replays each through its real tracer; drift here would
+# misattribute every worker-side lifecycle stamp.
+TRACE_STAGES: Tuple[str, ...] = (
+    "echoed",
+    "ready_quorum",
+    "delivered",
+    "echo_quorum",
+    "ready_sent",
+)
+_TRACE_IDX = {s: i for i, s in enumerate(TRACE_STAGES)}
 
 # The shared plane counter names, in wire order for E_STATS records.
 # MUST match the counter_group tuples in broadcast/stack.py and
@@ -90,6 +112,13 @@ _LOCAL_SENTINEL = bytes(32)  # peer_sign of a locally-submitted message
 
 _u64 = struct.Struct("<Q")
 _info = struct.Struct("<IQ")
+_prof = struct.Struct("<Bd")
+# O_PHASE per-phase head: phase idx (PHASES order), ns delta, histogram
+# count delta, histogram sum delta (seconds), ABSOLUTE histogram max
+# (merged with max() on the owner). Bucket deltas follow as
+# len(PHASE_BOUNDS)+1 little-endian u32s.
+_ophase = struct.Struct("<BQQdd")
+_otrace = struct.Struct("<32sQBd")
 
 
 @dataclass(frozen=True)
@@ -109,6 +138,15 @@ class WorkerSpec:
     ring_slots: int
     ring_slot_bytes: int
     parent_pid: int
+    # observability slice (all defaulted: pre-obs constructions and
+    # pickles keep working). Empty obs_ring = no shipping lane at all.
+    obs_ring: str = ""
+    recorder_cap: int = 0
+    trace_sample: int = 0
+    phase_accounting: bool = False
+    profiler_hz: float = 97.0
+    profiler_max_nodes: int = 20000
+    obs_flush_s: float = 0.05
 
 
 class _ProcMesh:
@@ -144,6 +182,173 @@ class _ProcDelivered:
         self._effects.put(
             E_DELIVER, payload.encode()[1:] + payload.content_hash()
         )
+
+
+class _WorkerTrace:
+    """TxTrace facade inside the worker: buffers ``(key, stage, t)``
+    stamps for the obs lane instead of mutating a tracer — the real
+    TxTrace lives in the owner, which replays these with the worker's
+    CLOCK_MONOTONIC timestamp preserved (machine-wide, so spans stay
+    aligned). Applies the same KEYED relay lottery obs/trace.py uses for
+    relay-side opens, so a sampled fleet ships only stamps the owner
+    could accept; at ``sample_every=1`` (the default) everything ships.
+    Records that were origin-sampled by the owner's SEQUENTIAL lottery
+    but lose the keyed one miss their worker-interior stamps — the
+    documented cost of sampling under process mode."""
+
+    __slots__ = ("_sample", "buf")
+
+    _CAP = 8192  # stamps buffered between flushes; beyond this we shed
+
+    def __init__(self, sample_every: int) -> None:
+        self._sample = max(1, int(sample_every))
+        self.buf: list = []
+
+    def stamp(self, key, stage: str, now=None) -> None:
+        idx = _TRACE_IDX.get(stage)
+        if idx is None:
+            return
+        if self._sample > 1 and (key[0][0] + key[1]) % self._sample:
+            return
+        if len(self.buf) >= self._CAP:
+            return
+        self.buf.append(
+            (key[0], key[1], idx, time.monotonic() if now is None else now)
+        )
+
+
+class _WorkerObs:
+    """The worker process's private slice of the diagnosis tier, plus
+    the shipping lane that folds it back into the owner's.
+
+    Each shard process runs its OWN registry + PhaseAccounting (so every
+    interior ``phases``/``recorder``/``trace`` mark site in
+    broadcast/stack.py lights up unchanged inside the worker), its own
+    FlightRecorder ring, and an opt-in StackSampler driven by C_PROF
+    records from the owner. Every ``obs_flush_s`` (~50ms) the worker
+    ships compact DELTA records over the dedicated obs ring:
+
+    * O_PHASE — per-phase ns + histogram bucket/sum/count deltas (max is
+      absolute, merged with max() on the owner), only for phases that
+      changed;
+    * O_REC — recorder events newer than the last ship, as the same
+      formatted JSON the /debugz dump uses;
+    * O_TRACE — buffered TxTrace stage stamps with their mono timestamp;
+    * O_FOLD — folded-stack increments (the sampler tree is reset after
+      each ship, so records are additive).
+
+    ``put`` never blocks: a full obs ring sheds the record and the drop
+    lands in the ring's producer-side counter, which the owner exports
+    as ``obs_records_dropped``. Observability loss is survivable and
+    accounted; it never backpressures the protocol.
+    """
+
+    def __init__(self, spec: "WorkerSpec", ring) -> None:
+        from ..obs.profiler import (
+            PHASE_BOUNDS,
+            PHASES,
+            PhaseAccounting,
+            StackSampler,
+        )
+        from ..obs.recorder import FlightRecorder
+        from ..obs.registry import Registry
+
+        self._ring = ring
+        self._phase_names = PHASES
+        self._nb = len(PHASE_BOUNDS) + 1
+        self._buckets = struct.Struct(f"<{self._nb}I")
+        self.registry = Registry()
+        self.phases = (
+            PhaseAccounting(self.registry) if spec.phase_accounting else None
+        )
+        self.recorder = (
+            FlightRecorder(cap=spec.recorder_cap)
+            if spec.recorder_cap
+            else None
+        )
+        self.trace = (
+            _WorkerTrace(spec.trace_sample) if spec.trace_sample else None
+        )
+        self.sampler = StackSampler(
+            hz=spec.profiler_hz, max_nodes=spec.profiler_max_nodes
+        )
+        self._last_phase: dict = {}
+        self._rec_seen = 0
+        self._flush_s = max(0.005, spec.obs_flush_s)
+        self._next_flush = time.monotonic() + self._flush_s
+
+    def handle_prof(self, payload: bytes) -> None:
+        start, duration = _prof.unpack(payload)
+        if start:
+            self.sampler.reset()
+            self.sampler.start(duration if duration > 0 else None)
+        else:
+            self.sampler.stop()
+            self._ship_fold()
+
+    def maybe_flush(self) -> None:
+        now = time.monotonic()
+        if now < self._next_flush:
+            return
+        self._next_flush = now + self._flush_s
+        self.flush()
+
+    def flush(self) -> None:
+        self._ship_phases()
+        self._ship_recorder()
+        self._ship_trace()
+        self._ship_fold()
+
+    def _ship_phases(self) -> None:
+        ph = self.phases
+        if ph is None:
+            return
+        parts = []
+        for idx, name in enumerate(self._phase_names):
+            ns = ph._counters[name].value
+            counts, total, count, mx = ph._hists[name].raw()
+            last = self._last_phase.get(name)
+            if last is None:
+                last = (0, [0] * self._nb, 0.0, 0, 0.0)
+            lns, lcounts, lsum, lcount, lmax = last
+            if ns == lns and count == lcount and mx == lmax:
+                continue
+            deltas = [a - b for a, b in zip(counts, lcounts)]
+            parts.append(
+                _ophase.pack(idx, ns - lns, count - lcount, total - lsum, mx)
+                + self._buckets.pack(*deltas)
+            )
+            self._last_phase[name] = (ns, counts, total, count, mx)
+        if parts:
+            self._ring.put(O_PHASE, b"".join(parts))
+
+    def _ship_recorder(self) -> None:
+        rec = self.recorder
+        if rec is None:
+            return
+        events, self._rec_seen = rec.events_since(self._rec_seen)
+        if events:
+            self._ring.put(O_REC, json.dumps(events).encode())
+
+    def _ship_trace(self) -> None:
+        tr = self.trace
+        if tr is None or not tr.buf:
+            return
+        buf, tr.buf = tr.buf, []
+        # chunked so one full ring sheds hundreds of stamps, not all 8k
+        for i in range(0, len(buf), 512):
+            self._ring.put(
+                O_TRACE,
+                b"".join(_otrace.pack(*stamp) for stamp in buf[i : i + 512]),
+            )
+
+    def _ship_fold(self) -> None:
+        samples = self.sampler.stats()["samples"]
+        if not samples:
+            return
+        folded = self.sampler.folded()
+        self.sampler.reset()
+        self._ring.put(O_FOLD, _u64.pack(samples) + folded.encode())
 
 
 def _flush_state(core, effects, last) -> None:
@@ -185,6 +390,11 @@ def worker_main(spec: WorkerSpec) -> None:
 
     actions_ring = ShmRing(spec.actions_ring)
     effects = ShmRing(spec.effects_ring)
+    obs = None
+    obs_ring = None
+    if spec.obs_ring:
+        obs_ring = ShmRing(spec.obs_ring)
+        obs = _WorkerObs(spec, obs_ring)
     peers = [
         Peer(address=a, exchange_public=x, sign_public=s, region=r)
         for a, x, s, r in spec.peers
@@ -198,6 +408,10 @@ def worker_main(spec: WorkerSpec) -> None:
         ready_threshold=spec.ready_threshold,
         workers=0,
         overlap_ready=spec.overlap_ready,
+        registry=obs.registry if obs is not None else None,
+        trace=obs.trace if obs is not None else None,
+        recorder=obs.recorder if obs is not None else None,
+        phases=obs.phases if obs is not None else None,
     )
     core.delivered = _ProcDelivered(effects)
     core.stall_handler = lambda: effects.put(E_STALL, b"")
@@ -212,15 +426,23 @@ def worker_main(spec: WorkerSpec) -> None:
     }
     idle = 0.0002
     stop = False
+    ph = obs.phases if obs is not None else None
     while not stop:
         if os.getppid() != spec.parent_pid:
             break  # orphaned: the owner died without a clean shutdown
         recs, _ = actions_ring.drain()
         if not recs:
+            if obs is not None:
+                obs.maybe_flush()
             time.sleep(idle)
             idle = min(idle * 2.0, 0.002)
             continue
         idle = 0.0002
+        # plane_total in a worker wraps the whole drain cycle (parse +
+        # verify + apply + state flush) — the worker-side twin of the
+        # owner-loop span, shipped as phase_plane_total_shardN_ns
+        t_plane = ph.begin_plane() if ph is not None else -1
+        t0 = ph.t() if ph is not None else 0
         to_verify: list = []
         acts: list = []
         for kind, payload in recs:
@@ -234,6 +456,8 @@ def worker_main(spec: WorkerSpec) -> None:
                     core._pre_msg(peer, msg, to_verify, acts)
             elif kind == C_GC:
                 core._gc_pass(struct.unpack("<d", payload)[0])
+                if ph is not None:
+                    t0 = ph.t()  # keep the GC sweep out of rx_decode
             elif kind == C_THRESH:
                 core.echo_threshold, core.ready_threshold = struct.unpack(
                     "<II", payload
@@ -242,17 +466,33 @@ def worker_main(spec: WorkerSpec) -> None:
                 core.restore_watermarks(json.loads(payload.decode()))
             elif kind == C_RELEASE:
                 core.release_entry(payload[:32], _u64.unpack(payload[32:])[0])
+            elif kind == C_PROF:
+                if obs is not None:
+                    obs.handle_prof(payload)
             elif kind == C_EXIT:  # tests: simulate a crash mid-campaign
                 os._exit(payload[0] if payload else 42)
             elif kind == C_SHUTDOWN:
                 stop = True
+        if ph is not None:
+            t0 = ph.add("rx_decode", t0)
         if to_verify:
             if native:
                 results = verify_bulk_native(to_verify, 1)
             else:
                 results = [verify_one(pk, m, s) for pk, m, s in to_verify]
+            if ph is not None:
+                t0 = ph.add("verify_wait", t0)
             core._apply_actions(acts, results)
         _flush_state(core, effects, last)
+        if ph is not None:
+            ph.end_plane(t_plane)
+        if obs is not None:
+            obs.maybe_flush()
     _flush_state(core, effects, last)
+    if obs is not None:
+        obs.sampler.stop()
+        obs.flush()
     actions_ring.close()
     effects.close()
+    if obs_ring is not None:
+        obs_ring.close()

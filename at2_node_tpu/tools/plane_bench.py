@@ -20,6 +20,10 @@ drives the per-tx plane.
 recorder (trace_sample=0, recorder_cap=0) so the observability overhead
 can be measured as the delta between two otherwise-identical runs — the
 ISSUE 6 acceptance budget is <5% throughput regression with both on.
+``--compare-obs`` honors ``--shards``/``--executor``, so the same A/B
+prices the cross-process obs shipping lane under ``--executor
+process``; each measurement banks as one executor-keyed row in
+BENCH_OBS_OVERHEAD.json (``--no-bank`` to skip).
 
 ``--shards N`` runs the firehose against the sharded broadcast plane
 (broadcast/shards.py); ``--executor thread|process|inline`` picks where
@@ -69,6 +73,7 @@ _REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 SHARDS_BANK_PATH = os.path.join(_REPO, "BENCH_PLANE_SHARDS.json")
+OBS_BANK_PATH = os.path.join(_REPO, "BENCH_OBS_OVERHEAD.json")
 
 
 class _TrustAllVerifier:
@@ -154,6 +159,11 @@ async def run(
             # walks ALL the in-process nodes' threads, which already
             # costs at least what a single node pays
             services[0].sampler.start()
+            # process-mode plane: fan the capture into the shard worker
+            # processes too, so the folded output carries shardN/ frames
+            wp = services[0]._plane_obs()
+            if wp is not None:
+                wp.profiler_start()
 
         # this tool IS the ingress (it bypasses the RPC surface), so it
         # stamps the tracer itself — the latency block below then carries
@@ -181,10 +191,22 @@ async def run(
         prof = None
         if profile and obs:
             services[0].sampler.stop()
-            folded = services[0].sampler.folded().splitlines()
+            wp = services[0]._plane_obs()
+            if wp is not None:
+                wp.profiler_stop()
+                # a couple of worker flush cycles so the final folded-
+                # stack increments land before we read the merge
+                await asyncio.sleep(0.3)
+            folded = services[0]._merged_folded(wp, None).splitlines()
             prof = {
                 "samples": services[0].sampler.stats()["samples"],
+                "worker_samples": (
+                    wp.worker_fold_samples() if wp is not None else 0
+                ),
                 "folded_lines": len(folded),
+                "worker_folded_frames": sum(
+                    1 for ln in folded if ln.startswith("shard")
+                ),
                 "top_folded": folded[:5],
             }
         committed = [s.committed for s in services]
@@ -254,15 +276,24 @@ async def run(
 
 def compare_obs(
     nodes: int, txs: int, verifier: str, timeout: float, batch: int,
-    repeat: int, budget_pct: float,
+    repeat: int, budget_pct: float, shards: int = 1,
+    executor: str = "thread", bank: bool = True,
 ) -> dict:
     """The observability-overhead assertion: interleave obs-on / obs-off
     firehose runs (alternation decorrelates thermal/scheduler drift from
     the arm), take each arm's best rate — best-of-N is the standard way
     to read a noisy 1-core host, the fastest run is the least-perturbed
-    one — and check the on-arm's regression against the budget."""
+    one — and check the on-arm's regression against the budget.
+
+    With ``--executor process`` the on arm additionally prices the
+    cross-process obs shipping lane (worker registry slices + delta
+    records over the dedicated obs rings, broadcast/shards.py); the off
+    arm's all-zero ObservabilityConfig keeps that lane entirely off, so
+    the delta measures the whole tier in BOTH execution modes under the
+    same budget."""
     arms: dict = {"on": [], "off": []}
     samples = 0
+    worker_samples = 0
     audit_on: dict = {}
     for _ in range(repeat):
         for obs in (True, False):
@@ -272,7 +303,7 @@ def compare_obs(
             # beacons, and the inbound wire-capture ring
             res = asyncio.run(
                 run(nodes, txs, verifier, timeout, batch, obs=obs,
-                    profile=obs)
+                    profile=obs, shards=shards, executor=executor)
             )
             if res["timed_out"]:
                 raise RuntimeError(
@@ -282,6 +313,7 @@ def compare_obs(
             arms["on" if obs else "off"].append(res["committed_tx_per_sec"])
             if res["profiler"]:
                 samples += res["profiler"]["samples"]
+                worker_samples += res["profiler"].get("worker_samples", 0)
             if obs:
                 for k, v in res["audit"].items():
                     audit_on[k] = audit_on.get(k, 0) + v
@@ -289,16 +321,21 @@ def compare_obs(
     overhead_pct = (
         round(100.0 * (1.0 - best_on / best_off), 2) if best_off else 0.0
     )
-    return {
+    row = {
         "config": "observability overhead (plane firehose, best-of-N)",
         "nodes": nodes,
         "verifier": verifier,
         "batch": batch,
+        "shards": shards,
+        "executor": "loop" if shards == 1 else executor,
         "submitted": txs,
         "repeat": repeat,
         "rates_on": arms["on"],
         "rates_off": arms["off"],
         "sampler_samples_on": samples,
+        # on-arm folded-stack samples shipped FROM shard workers — zero
+        # outside process mode, nonzero proves the obs lane was priced
+        "worker_samples_on": worker_samples,
         # summed over the on-arm runs: nonzero beacons/captures prove
         # the priced tier actually included the fleet auditor + capture
         "audit_on": audit_on,
@@ -308,6 +345,44 @@ def compare_obs(
         "budget_pct": budget_pct,
         "ok": overhead_pct <= budget_pct,
     }
+    if bank:
+        bank_obs_row(row)
+    return row
+
+
+def bank_obs_row(row: dict) -> None:
+    """Upsert one compare_obs measurement into BENCH_OBS_OVERHEAD.json.
+
+    The banked doc is ``{"config": ..., "rows": [row, ...]}`` with one
+    row per (executor, shards, nodes, batch, submitted) cell — a
+    process-mode capture never overwrites the loop-mode one (regress.py
+    keys the series by executor too). A legacy single-doc capture is
+    migrated in place as a ``"loop"`` row."""
+    doc: dict = {}
+    if os.path.exists(OBS_BANK_PATH):
+        with open(OBS_BANK_PATH) as fp:
+            doc = json.load(fp)
+    if "rows" not in doc:
+        rows = [dict(doc, executor=doc.get("executor", "loop"))] if (
+            "overhead_pct" in doc
+        ) else []
+        doc = {
+            "config": "observability overhead (plane firehose, "
+                      "best-of-N), one row per executor cell",
+            "rows": rows,
+        }
+    key = lambda r: (  # noqa: E731 - local row identity
+        r.get("executor", "loop"), r.get("shards", 1), r.get("nodes"),
+        r.get("batch"), r.get("submitted"),
+    )
+    doc["rows"] = [r for r in doc["rows"] if key(r) != key(row)] + [row]
+    doc["rows"].sort(key=lambda r: json.dumps(key(r), default=str))
+    tmp = OBS_BANK_PATH + ".tmp"
+    with open(tmp, "w") as fp:
+        json.dump(doc, fp, indent=1)
+        fp.write("\n")
+    os.replace(tmp, OBS_BANK_PATH)
+    print("banked %s" % OBS_BANK_PATH, file=sys.stderr)
 
 
 # every phase account a cpu-verifier batched run can exercise:
@@ -318,35 +393,59 @@ def compare_obs(
 _SMOKE_PHASES = PLANE_LEAF_PHASES + ("plane_total", "commit_tail", "slot_gc")
 
 
-def smoke_profile(nodes: int, txs: int, timeout: float) -> dict:
+def smoke_profile(
+    nodes: int, txs: int, timeout: float, shards: int = 1,
+    executor: str = "thread",
+) -> dict:
     """The CI profiler smoke (ISSUE 11): one short batched firehose with
     the sampler live, then assert the capture produced folded stacks and
-    every exercisable phase counter actually ticked."""
+    every exercisable phase counter actually ticked.
+
+    With ``--shards N --executor process`` the smoke additionally
+    asserts the cross-process obs lane end to end: the merged folded
+    output must carry ``shardN/``-prefixed worker frames, and every
+    plane leaf phase must have ticked inside SOME worker (the firehose
+    has one origin key, so one shard carries the traffic — the check is
+    any-shard per phase, not every-shard)."""
     res = asyncio.run(
         run(nodes, txs, "cpu", timeout, batch=16, obs=True,
-            profile=True, linger=5.5)
+            profile=True, linger=5.5, shards=shards, executor=executor)
     )
     stats = res["node0_stats"]
     zero = [p for p in _SMOKE_PHASES if not stats.get(f"phase_{p}_ns", 0)]
     prof = res["profiler"] or {}
+    proc = shards > 1 and executor == "process"
+    worker_frames = prof.get("worker_folded_frames", 0)
+    shard_zero = [
+        p for p in PLANE_LEAF_PHASES
+        if not any(
+            stats.get(f"phase_{p}_shard{k}_ns", 0) for k in range(shards)
+        )
+    ] if proc else []
     ok = (
         bool(prof.get("folded_lines"))
         and not zero
         and not res["timed_out"]
+        and (not proc or (worker_frames > 0 and not shard_zero))
     )
     return {
         "config": "profiler smoke (batched firehose, sampler live)",
         "nodes": nodes,
         "submitted": txs,
+        "shards": shards,
+        "executor": res["executor"],
         "timed_out": res["timed_out"],
         "committed_tx_per_sec": res["committed_tx_per_sec"],
         "samples": prof.get("samples", 0),
+        "worker_samples": prof.get("worker_samples", 0),
         "folded_lines": prof.get("folded_lines", 0),
+        "worker_folded_frames": worker_frames,
         "top_folded": prof.get("top_folded", []),
         "phase_ns": {
             p: stats.get(f"phase_{p}_ns", 0) for p in _SMOKE_PHASES
         },
         "zero_phases": zero,
+        "shard_zero_phases": shard_zero,
         "ok": ok,
     }
 
@@ -626,8 +725,9 @@ def main(argv=None) -> int:
                          "device tunnel for the row label (0 = skip, "
                          "rows say tunnel_live_at_write=null)")
     ap.add_argument("--no-bank", action="store_true",
-                    help="with --shards-grid: measure + print only, do "
-                         "not rewrite BENCH_PLANE_SHARDS.json (CI smoke)")
+                    help="with --shards-grid / --compare-obs: measure + "
+                         "print only, do not rewrite the banked artifact "
+                         "(CI smoke)")
     ap.add_argument("--obs", default="on", choices=("on", "off"),
                     help="lifecycle tracer + flight recorder (off: measure "
                          "the plane with zero observability overhead)")
@@ -661,11 +761,15 @@ def main(argv=None) -> int:
             bank=not args.no_bank,
         )
     elif args.smoke_profile:
-        result = smoke_profile(args.nodes, args.txs, args.timeout)
+        result = smoke_profile(
+            args.nodes, args.txs, args.timeout,
+            shards=args.shards, executor=args.executor,
+        )
     elif args.compare_obs:
         result = compare_obs(
             args.nodes, args.txs, args.verifier, args.timeout, args.batch,
-            args.repeat, args.budget,
+            args.repeat, args.budget, shards=args.shards,
+            executor=args.executor, bank=not args.no_bank,
         )
     else:
         result = asyncio.run(
@@ -681,12 +785,18 @@ def main(argv=None) -> int:
             f.write(blob)
         print(f"wrote {args.out}", file=sys.stderr)
     if args.smoke_profile and not result["ok"]:
-        print(
-            "profiler smoke failed: "
-            + (f"zero phase counters {result['zero_phases']}"
-               if result["zero_phases"] else "no folded stacks captured"),
-            file=sys.stderr,
-        )
+        if result["zero_phases"]:
+            why = f"zero phase counters {result['zero_phases']}"
+        elif result["shard_zero_phases"]:
+            why = (
+                "worker-side phase counters never ticked "
+                f"{result['shard_zero_phases']}"
+            )
+        elif not result["folded_lines"]:
+            why = "no folded stacks captured"
+        else:
+            why = "no shardN/ worker frames in the merged folded output"
+        print(f"profiler smoke failed: {why}", file=sys.stderr)
         return 1
     if args.compare_drain and not result["serial_share_reduced"]:
         print(

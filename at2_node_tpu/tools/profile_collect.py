@@ -22,7 +22,13 @@ Per node the report shows:
   - the top serial term: the largest leaf share, attributed to the
     hottest sampled stack's leaf frame (file:line),
   - the node's build block (git SHA, Python/JAX versions, config hash)
-    so reports are comparable across fleet versions.
+    so reports are comparable across fleet versions,
+  - in process mode, a per-shard row per worker (its plane wall time and
+    hottest leaf phase from the ``phase_*_shardN_ns`` fold); the
+    coverage denominator is the MERGED plane total — owner
+    ``phase_plane_total_ns`` delta plus every worker's
+    ``phase_plane_total_shardN_ns`` delta — so coverage stays honest
+    when most plane time runs inside worker processes.
 
 ``--min-coverage PCT`` makes the exit code a gate: nonzero when any
 node's leaf phases explain less than PCT% of its plane wall time —
@@ -35,6 +41,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import re
 import sys
 
 from ..obs.profiler import PLANE_LEAF_PHASES, PHASES, build_info
@@ -43,6 +50,9 @@ from ._common import fetch_json, parse_addr as _parse_addr
 _OFF_PLANE = tuple(
     p for p in PHASES if p not in PLANE_LEAF_PHASES and p != "plane_total"
 )
+
+# process-mode fold keys (broadcast/shards.py): per-shard phase counters
+_SHARD_KEY = re.compile(r"^phase_([a-z_]+)_shard(\d+)_ns$")
 
 
 def _phase_deltas(stats0: dict, stats1: dict) -> dict:
@@ -56,6 +66,25 @@ def _phase_deltas(stats0: dict, stats1: dict) -> dict:
             out[p] = max(0, int(v1) - int(v0))
         else:
             out[p] = 0
+    return out
+
+
+def _shard_deltas(stats0: dict, stats1: dict) -> dict:
+    """Per-shard phase ns deltas, ``{shard_id: {phase: ns}}``, from the
+    ``phase_<p>_shard<k>_ns`` counters the process-mode obs fold
+    maintains (broadcast/shards.py). Empty in thread/inline mode —
+    those counters simply never exist there."""
+    out: dict = {}
+    for key, v1 in stats1.items():
+        m = _SHARD_KEY.match(key) if isinstance(key, str) else None
+        if not m or not isinstance(v1, (int, float)):
+            continue
+        v0 = stats0.get(key, 0)
+        if not isinstance(v0, (int, float)):
+            v0 = 0
+        out.setdefault(int(m.group(2)), {})[m.group(1)] = max(
+            0, int(v1) - int(v0)
+        )
     return out
 
 
@@ -78,7 +107,14 @@ def decompose(stats0: dict, stats1: dict, profile: dict) -> dict:
     """One node's plane decomposition from two /statusz snapshots and
     the /profilez dump. Pure function of its inputs — unit-testable."""
     deltas = _phase_deltas(stats0, stats1)
-    total = deltas.get("plane_total", 0)
+    shard = _shard_deltas(stats0, stats1)
+    # merged denominator: worker leaf time folds into the base counters,
+    # but worker plane_total lives ONLY under the shard keys — counting
+    # just the owner's plane_total would overstate coverage in process
+    # mode (leaves from N workers over one owner's wall time)
+    total = deltas.get("plane_total", 0) + sum(
+        d.get("plane_total", 0) for d in shard.values()
+    )
     shares = {
         p: (deltas[p] / total if total else 0.0) for p in PLANE_LEAF_PHASES
     }
@@ -86,10 +122,26 @@ def decompose(stats0: dict, stats1: dict, profile: dict) -> dict:
     top_phase = max(
         PLANE_LEAF_PHASES, key=lambda p: shares[p]
     ) if total else None
+    shards_out = {}
+    for sid in sorted(shard):
+        d = shard[sid]
+        st = d.get("plane_total", 0)
+        leaf = {p: d.get(p, 0) for p in PLANE_LEAF_PHASES}
+        top = max(leaf, key=lambda p: leaf[p]) if any(leaf.values()) else None
+        shards_out[sid] = {
+            "plane_total_ms": st / 1e6,
+            "phase_ms": {p: leaf[p] / 1e6 for p in PLANE_LEAF_PHASES},
+            "shares": {
+                p: (leaf[p] / st if st else 0.0) for p in PLANE_LEAF_PHASES
+            },
+            "top_phase": top,
+        }
     return {
         "plane_total_ms": total / 1e6,
+        "owner_plane_total_ms": deltas.get("plane_total", 0) / 1e6,
         "phase_ms": {p: deltas[p] / 1e6 for p in PHASES},
         "shares": shares,
+        "shards": shards_out,
         "off_plane_ms": {p: deltas[p] / 1e6 for p in _OFF_PLANE},
         "coverage": coverage,
         "top_serial": {
@@ -142,7 +194,17 @@ def render(results, duration: float, min_coverage: float, out) -> int:
             file=out,
         )
         total = rec["plane_total_ms"]
-        print(f"  plane_total {total:.1f} ms over the window", file=out)
+        shards = rec.get("shards") or {}
+        if shards:
+            print(
+                f"  plane_total {total:.1f} ms over the window "
+                f"(owner {rec.get('owner_plane_total_ms', 0.0):.1f} ms + "
+                f"{len(shards)} worker shard"
+                f"{'s' if len(shards) != 1 else ''})",
+                file=out,
+            )
+        else:
+            print(f"  plane_total {total:.1f} ms over the window", file=out)
         for p in PLANE_LEAF_PHASES:
             print(
                 f"    {p:<16}{rec['phase_ms'][p]:>10.1f} ms"
@@ -155,6 +217,18 @@ def render(results, duration: float, min_coverage: float, out) -> int:
             f"{p}={rec['off_plane_ms'][p]:.1f}ms" for p in _OFF_PLANE
         )
         print(f"  off-plane: {off}", file=out)
+        for sid in sorted(shards, key=int):
+            srec = shards[sid]
+            top = srec.get("top_phase")
+            top_s = (
+                f"{top} {100.0 * srec['shares'][top]:.1f}%"
+                if top else "(idle)"
+            )
+            print(
+                f"  shard{sid}: plane {srec['plane_total_ms']:>8.1f} ms"
+                f"  top {top_s}",
+                file=out,
+            )
         top = rec["top_serial"]
         print(
             f"  top serial term: {top['phase']} "

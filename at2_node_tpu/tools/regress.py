@@ -395,35 +395,54 @@ def load_scenarios(name: str, doc: dict) -> List[dict]:
 
 
 def load_obs_overhead(name: str, doc: dict) -> List[dict]:
+    """BENCH_OBS_OVERHEAD.json: one compare_obs measurement per executor
+    cell. Current shape is ``{"config", "rows": [row, ...]}``; a legacy
+    single-doc capture (pre-process-mode) loads as one implicit
+    ``"loop"`` row. The executor joins both the series name and the
+    comparability key — a loop-mode on-arm rate and a process-mode one
+    (which additionally pays the obs shipping lane) are different
+    machines and must never diff against each other."""
     _require(doc, "config", name)
-    _num(doc, "overhead_pct", name)
-    _num(doc, "budget_pct", name)
-    # fleet-audit activity block (optional: pre-auditor banks lack it).
-    # Schema-only validation — beacon/capture counts label what the
-    # measured tier contained, they are not a judged series.
-    audit = doc.get("audit_on")
-    if audit is not None:
-        for key in ("beacons_tx", "captured_frames"):
-            if not isinstance(audit.get(key), (int, float)):
-                raise ValueError(
-                    f"{name}: audit_on.{key} missing or non-numeric"
-                )
-    comp = (
-        f"nodes={doc.get('nodes')} batch={doc.get('batch')} "
-        f"submitted={doc.get('submitted')}"
-    )
-    # the on-arm throughput is the tracked series (overhead_pct hovers
-    # around zero, where percent-delta judging is ill-conditioned; the
-    # <budget assertion itself lives in the plane_bench CI gate)
-    return [
-        _row(
-            "obs/best_on_tx_per_sec",
-            "current",
-            0,
-            _num(doc, "best_on_tx_per_sec", name),
-            comp,
+    entries = doc.get("rows")
+    if entries is None:
+        entries = [doc]  # legacy single-doc capture
+    elif not isinstance(entries, list):
+        raise SchemaError(f"{name}.rows: expected list")
+    rows: List[dict] = []
+    for i, row in enumerate(entries):
+        path = f"{name}.rows[{i}]" if "rows" in doc else name
+        _num(row, "overhead_pct", path)
+        _num(row, "budget_pct", path)
+        # fleet-audit activity block (optional: pre-auditor banks lack
+        # it). Schema-only validation — beacon/capture counts label what
+        # the measured tier contained, they are not a judged series.
+        audit = row.get("audit_on")
+        if audit is not None:
+            for key in ("beacons_tx", "captured_frames"):
+                if not isinstance(audit.get(key), (int, float)):
+                    raise SchemaError(
+                        f"{path}: audit_on.{key} missing or non-numeric"
+                    )
+        executor = row.get("executor", "loop")
+        comp = (
+            f"nodes={row.get('nodes')} batch={row.get('batch')} "
+            f"submitted={row.get('submitted')} executor={executor} "
+            f"shards={row.get('shards', 1)}"
         )
-    ]
+        # the on-arm throughput is the tracked series (overhead_pct
+        # hovers around zero, where percent-delta judging is ill-
+        # conditioned; the <budget assertion itself lives in the
+        # plane_bench CI gate)
+        rows.append(
+            _row(
+                f"obs/{executor}.best_on_tx_per_sec",
+                "current",
+                0,
+                _num(row, "best_on_tx_per_sec", path),
+                comp,
+            )
+        )
+    return rows
 
 
 def load_overload(name: str, doc: dict) -> List[dict]:

@@ -5,7 +5,11 @@ between refreshes), ingress→commit latency percentiles, verifier
 occupancy and queue-wait, broadcast slot backlog, [overload] pressure
 and shed rate, and per-node health —
 straight from the observability endpoints the mux serves, no RPC stubs
-and no dependencies beyond the stdlib.
+and no dependencies beyond the stdlib. When a node runs process-mode
+plane shards, the ``hot shard`` column names its busiest worker shard
+phase since the last frame (largest ``phase_*_shardN_ns`` delta, as a
+share of that shard's plane time) and the shards cell grows an ``obs!``
+marker while the cross-process obs lane is dropping delta records.
 
 Usage:
     python -m at2_node_tpu.tools.top HOST:PORT [HOST:PORT ...]
@@ -42,6 +46,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import re
 import sys
 import time
 
@@ -68,6 +73,56 @@ def _shed_basis(sz: dict) -> int:
     return _num(stats, "overload_shed_entries") + _num(
         stats, "overload_shed_distilled"
     )
+
+
+# process-mode obs fold keys (broadcast/shards.py): per-shard phase
+# counters shipped from the plane worker processes
+_SHARD_PHASE = re.compile(r"^phase_([a-z_]+)_shard(\d+)_ns$")
+
+
+def _shard_phase_basis(sz: dict) -> dict:
+    """Cumulative per-shard phase counters from /statusz stats — the
+    rate basis for the ``hot shard`` column. Empty outside process mode
+    (the fold keys don't exist there)."""
+    out = {}
+    for k, v in sz.get("stats", {}).items():
+        if isinstance(k, str) and isinstance(v, (int, float)):
+            if _SHARD_PHASE.match(k):
+                out[k] = int(v)
+    return out
+
+
+def _hot_shard_cell(addr: str, sz: dict, prev) -> str:
+    """The ``hot shard`` column: the worker shard phase with the largest
+    ns delta since the previous frame, as ``N:phase share%`` where the
+    share is of that shard's plane_total delta. "-" outside process
+    mode; blank on the first frame; "idle" when no worker phase moved."""
+    cur = _shard_phase_basis(sz)
+    if not cur:
+        return "-"
+    seen = prev.get(addr)
+    if seen is None or len(seen) < 5:
+        return ""
+    base = seen[4] or {}
+    per: dict = {}
+    for k, v in cur.items():
+        m = _SHARD_PHASE.match(k)
+        per.setdefault(int(m.group(2)), {})[m.group(1)] = max(
+            0, v - base.get(k, 0)
+        )
+    cell, best = "idle", 0
+    for sid in sorted(per):
+        phases = per[sid]
+        total = phases.get("plane_total", 0) or sum(
+            d for p, d in phases.items() if p != "plane_total"
+        )
+        for p, d in phases.items():
+            if p == "plane_total" or d <= best:
+                continue
+            best = d
+            share = d / total if total else 0.0
+            cell = f"{sid}:{p[:8]} {100.0 * share:.0f}%"
+    return cell
 
 
 def _pressure_cell(sz: dict) -> str:
@@ -123,7 +178,7 @@ def render_frame(rows, now: float, prev) -> str:
         f"{'lag p99':>9}"
         f"{'backlog':>9}{'press':>7}{'shed/s':>8}"
         f"{'dstl rx/ms/dd':>15}{'peers':>7}"
-        f"{'shards':>8}{'epoch':>7}  {'recovery':<16}"
+        f"{'shards':>8}{'hot shard':>17}{'epoch':>7}  {'recovery':<16}"
     )
     lines = []
     # fleet build line: every distinct (git SHA, config hash) the nodes
@@ -190,6 +245,7 @@ def render_frame(rows, now: float, prev) -> str:
                 f"{drops:>15}"
                 f"{_num(stats, 'broker_registrations'):>7}"
                 f"{'-':>8}"
+                f"{'-':>17}"
                 f"{'-':>7}  {'-':<16}"
             )
             continue
@@ -240,7 +296,10 @@ def render_frame(rows, now: float, prev) -> str:
         # "4/t" four shard threads, "4/p" four worker processes
         # (broadcast/shards.py). A trailing ! counts dropped effect
         # records (full handoff ring/queue — the plane is shedding), a
-        # trailing X flags crashed shard workers (process mode).
+        # trailing X flags crashed shard workers (process mode), a
+        # trailing obs! means the obs shipping lane is dropping delta
+        # records (phase/recorder/trace data is lossy right now — the
+        # protocol itself is unaffected).
         plane = sz.get("plane", {})
         if plane:
             shards_s = (
@@ -251,6 +310,10 @@ def render_frame(rows, now: float, prev) -> str:
                 shards_s += f"!{eff_drop}"
             if plane.get("worker_crashed"):
                 shards_s += f"X{len(plane['worker_crashed'])}"
+            od = _num(stats, "obs_records_dropped")
+            prev_od = seen[3] if seen is not None and len(seen) >= 4 else 0
+            if od > prev_od:
+                shards_s += "obs!"
         else:
             shards_s = "-"
         lines.append(
@@ -274,6 +337,7 @@ def render_frame(rows, now: float, prev) -> str:
             f"{_num(health, 'peers_connected'):>4}/"
             f"{_num(health, 'peers_configured'):<2}"
             f"{shards_s:>8}"
+            f"{_hot_shard_cell(addr, sz, prev):>17}"
             f"{_num(health, 'epoch'):>7}  "
             f"{_recovery_cell(sz.get('recovery', {})):<16}"
         )
@@ -448,7 +512,11 @@ async def run(addrs, interval: float, once: bool, clear: bool,
                     if sz.get("role") == "broker"
                     else _num(sz.get("health", {}), "committed")
                 )
-                prev[addr] = (now, basis, _shed_basis(sz))
+                prev[addr] = (
+                    now, basis, _shed_basis(sz),
+                    _num(sz.get("stats", {}), "obs_records_dropped"),
+                    _shard_phase_basis(sz),
+                )
         if once:
             # scripting/CI contract: nonzero when ANY polled node is
             # unreachable or self-reports degraded health — a fleet

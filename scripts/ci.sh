@@ -396,6 +396,20 @@ echo "== profiler smoke gate =="
 # got dropped from a hot path.
 python -m at2_node_tpu.tools.plane_bench --smoke-profile --nodes 3 \
     --txs 200 --out /dev/null
+# Cross-process observability (ISSUE 18): the same smoke through the
+# process executor. Worker processes ship their own phase marks,
+# recorder events, and folded stacks over per-shard obs rings; the
+# smoke fails unless the merged folded output carries shardN/ frames
+# AND every plane leaf phase ticked in at least one worker shard — a
+# silent 0 means a worker-side mark (or the shipping lane itself)
+# broke. Needs a real second core for the worker process, same policy
+# as the scaling smokes.
+if [ "$(nproc)" -ge 2 ]; then
+  python -m at2_node_tpu.tools.plane_bench --smoke-profile --nodes 3 \
+      --txs 200 --shards 2 --executor process --out /dev/null
+else
+  echo "single-core host: skipping the process-mode profiler smoke"
+fi
 
 echo "== sharded-plane gate =="
 # Sharded broadcast plane (ISSUE 12): the invariance suite first (named

@@ -1,0 +1,473 @@
+"""Cross-process observability: the obs shipping lane (ISSUE 18).
+
+The contract being pinned: moving a plane shard into a worker PROCESS
+must not blind the diagnosis tier. Each worker runs its own registry
+slice (PhaseAccounting, FlightRecorder, TxTrace stamps, StackSampler)
+and ships compact DELTA records over a dedicated per-shard obs ring;
+the owner folds them into the one registry every surface reads.
+
+* record fidelity — phase ns + histogram deltas, recorder events, and
+  trace stamps survive the ring byte-exact: the owner's folded counters
+  equal the worker's in-process numbers (the same equivalence
+  thread-mode ``shard_view`` provides for free);
+* drop accounting — a full obs ring sheds records into the ring's drop
+  counter and never corrupts what DID ship; observability loss is
+  survivable and visible (``obs_records_dropped``), never fatal;
+* crash forensics — on worker death the owner drains the dead shard's
+  obs ring post-mortem and attaches the recorder-event tail + last
+  phase snapshot to the ``plane_worker_crash`` snapshot, which rides
+  /debugz into incident bundles unchanged;
+* executor invariance — the sim campaign hash is identical with the
+  full obs tier enabled at executor=process (the sim clock forces
+  inline execution, so the lane never engages under determinism).
+"""
+
+import asyncio
+import itertools
+import json
+import os
+import struct
+from types import SimpleNamespace
+
+import pytest
+
+from at2_node_tpu.broadcast.shards import ShardedPlane
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.node.config import PlaneConfig
+from at2_node_tpu.node.service import Service
+from at2_node_tpu.obs.profiler import (
+    PHASE_BOUNDS,
+    PHASES,
+    PLANE_LEAF_PHASES,
+    PhaseAccounting,
+)
+from at2_node_tpu.obs.recorder import FlightRecorder
+from at2_node_tpu.obs.registry import Registry
+from at2_node_tpu.obs.trace import TxTrace
+from at2_node_tpu.parallel import plane_worker as pw
+from at2_node_tpu.parallel.ring import ShmRing
+from at2_node_tpu.sim.campaign import run_episode
+from at2_node_tpu.types import ThinTransaction
+
+from conftest import make_net_configs, wait_until
+
+_ports = itertools.count(29400)
+_ring_ids = itertools.count()
+
+
+def _obs_spec(**kw):
+    """The WorkerSpec fields _WorkerObs actually reads — a unit-test
+    stand-in for the full picklable spec."""
+    base = dict(
+        recorder_cap=256,
+        trace_sample=1,
+        phase_accounting=True,
+        profiler_hz=97.0,
+        profiler_max_nodes=1000,
+        obs_flush_s=0.005,
+    )
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def _owner_plane(shards=1):
+    """An inline ShardedPlane wearing the full owner-side obs kit: the
+    fold path (_apply_obs_record) is pure in (sid, kind, payload) and
+    does not need live worker processes."""
+    reg = Registry()
+    plane = ShardedPlane(
+        SignKeyPair.random(),
+        SimpleNamespace(peers=[], by_sign={}),
+        None,
+        shards=shards,
+        executor="inline",
+        registry=reg,
+        phases=PhaseAccounting(reg),
+        trace=TxTrace(reg, sample_every=1),
+        recorder=FlightRecorder(cap=256),
+    )
+    return plane, reg
+
+
+def _fresh_ring(slots=256, slot_bytes=1024):
+    return ShmRing(
+        f"at2obs-test-{os.getpid()}-{next(_ring_ids)}",
+        slots=slots,
+        slot_bytes=slot_bytes,
+        create=True,
+    )
+
+
+def _apply_all(plane, ring, sid=0):
+    recs, _ = ring.drain()
+    for kind, payload in recs:
+        plane._apply_obs_record(sid, kind, payload)
+    return len(recs)
+
+
+def make_payload(keypair, seq=1, amount=10, recipient=b"r" * 32):
+    from at2_node_tpu.broadcast.messages import Payload
+
+    return Payload.create(keypair, seq, ThinTransaction(recipient, amount))
+
+
+# ---------------------------------------------------------------------------
+# record fidelity units: worker slice -> ring -> owner fold
+
+
+class TestObsRecordsOverRing:
+    def test_phase_fold_matches_worker_numbers(self):
+        """The process-mode equivalent of thread-mode shard_view: after
+        the fold, base leaf counters AND the shardN counters carry
+        exactly the ns the worker accounted, histograms merge count/sum
+        exactly, and the worker's plane_total lands ONLY under its
+        shardN name (different denominator, summed by profile_collect)."""
+        ring = _fresh_ring()
+        try:
+            obs = pw._WorkerObs(_obs_spec(), ring)
+            marks = {p: (i + 1) * 1_000_000 for i, p in
+                     enumerate(PLANE_LEAF_PHASES)}
+            for p, ns in marks.items():
+                obs.phases.add_ns(p, ns)
+            obs.phases.add_ns("plane_total", 99_000_000)
+            obs.phases.add_ns("slot_gc", 7_000_000)
+            obs.flush()
+
+            plane, reg = _owner_plane()
+            assert _apply_all(plane, ring) >= 1
+            for p, ns in marks.items():
+                assert reg.counter(f"phase_{p}_ns").value == ns
+                assert reg.counter(f"phase_{p}_shard0_ns").value == ns
+                counts, total_s, count, _mx = reg.histogram(
+                    f"phase_{p}", bounds=PHASE_BOUNDS
+                ).raw()
+                assert count == 1 and sum(counts) == 1
+                assert total_s == pytest.approx(ns * 1e-9)
+            # slot_gc is not a plane leaf: base only, no shard counter
+            assert reg.counter("phase_slot_gc_ns").value == 7_000_000
+            assert "phase_slot_gc_shard0_ns" not in reg.snapshot()
+            # plane_total: shardN only — the worker drain-cycle span
+            # must never inflate the owner's own plane_total
+            assert reg.counter("phase_plane_total_ns").value == 0
+            assert (
+                reg.counter("phase_plane_total_shard0_ns").value
+                == 99_000_000
+            )
+        finally:
+            ring.close()
+
+    def test_second_flush_ships_only_deltas(self):
+        """Records are DELTAS: a second flush after more marks must add
+        exactly the increment, not re-ship the cumulative totals."""
+        ring = _fresh_ring()
+        try:
+            obs = pw._WorkerObs(_obs_spec(), ring)
+            plane, reg = _owner_plane()
+            obs.phases.add_ns("verify_wait", 5_000_000)
+            obs.flush()
+            _apply_all(plane, ring)
+            obs.phases.add_ns("verify_wait", 3_000_000)
+            obs.flush()
+            _apply_all(plane, ring)
+            assert reg.counter("phase_verify_wait_ns").value == 8_000_000
+            assert (
+                reg.counter("phase_verify_wait_shard0_ns").value
+                == 8_000_000
+            )
+            _c, _s, count, _m = reg.histogram(
+                "phase_verify_wait", bounds=PHASE_BOUNDS
+            ).raw()
+            assert count == 2
+            # an idle flush (nothing changed) ships no phase record
+            before = len(ring.drain()[0])
+            obs.flush()
+            phase_recs = [
+                k for k, _ in ring.drain()[0] if k == pw.O_PHASE
+            ]
+            assert before == 0 and phase_recs == []
+        finally:
+            ring.close()
+
+    def test_recorder_events_survive_with_shard_prefix(self):
+        ring = _fresh_ring()
+        try:
+            obs = pw._WorkerObs(_obs_spec(), ring)
+            obs.recorder.record("echo", (7,))
+            obs.recorder.record("ready_quorum", (7,))
+            obs.flush()
+            plane, _reg = _owner_plane(shards=2)
+            _apply_all(plane, ring, sid=1)
+            events = plane.worker_events()
+            codes = [e[1] for e in events]
+            assert codes == ["shard1/echo", "shard1/ready_quorum"]
+            # mono timestamps preserved and sorted
+            assert events == sorted(events, key=lambda e: e[0])
+            # only NEW events ship on the next flush
+            obs.recorder.record("stall_kick", ())
+            obs.flush()
+            _apply_all(plane, ring, sid=1)
+            assert [e[1] for e in plane.worker_events()] == [
+                "shard1/echo",
+                "shard1/ready_quorum",
+                "shard1/stall_kick",
+            ]
+        finally:
+            ring.close()
+
+    def test_trace_stamps_replay_on_owner_tracer(self):
+        """A worker stage stamp must materialize in the owner's TxTrace
+        as a relay-open record at the worker's mono timestamp — the
+        exact behavior thread-mode cores get by sharing the tracer."""
+        ring = _fresh_ring()
+        try:
+            obs = pw._WorkerObs(_obs_spec(), ring)
+            sender = b"\xab" * 32
+            obs.trace.stamp((sender, 3), "delivered", now=123.25)
+            obs.flush()
+            plane, _reg = _owner_plane()
+            _apply_all(plane, ring)
+            rec = plane.trace._live.get((sender, 3))
+            assert rec is not None
+            stages = {s for s, _m, _w in rec[3]}
+            assert "delivered" in stages
+            mono = [m for s, m, _w in rec[3] if s == "delivered"]
+            assert mono == [pytest.approx(123.25)]
+        finally:
+            ring.close()
+
+    def test_trace_lottery_matches_owner_sampling(self):
+        """At sample_every=N the worker applies the SAME keyed lottery
+        the owner tracer uses, so shipped stamps are exactly the ones
+        the owner would have kept."""
+        obs = pw._WorkerObs(_obs_spec(trace_sample=4), ring=None)
+        kept = []
+        for seq in range(32):
+            sender = bytes([seq % 7]) * 32
+            obs.trace.stamp((sender, seq), "echoed", now=1.0)
+        for sender, seq, _idx, _mono in obs.trace.buf:
+            kept.append((sender[0] + seq) % 4)
+        assert kept and set(kept) == {0}
+
+    def test_ring_wrap_drops_counted_not_fatal(self):
+        """put-never-blocks: a tiny obs ring under a burst sheds records
+        into the drop counter; everything that DID ship still folds
+        cleanly on the owner."""
+        ring = _fresh_ring(slots=8, slot_bytes=256)
+        try:
+            obs = pw._WorkerObs(_obs_spec(), ring)
+            for i in range(64):
+                obs.recorder.record("echo", (i, "x" * 40))
+                obs.flush()
+            assert ring.dropped > 0
+            plane, _reg = _owner_plane()
+            applied = _apply_all(plane, ring)
+            assert applied > 0
+            assert plane.worker_events()  # survivors folded fine
+        finally:
+            ring.close()
+
+    def test_unknown_phase_idx_is_shed(self):
+        """Vocabulary drift (a worker from a newer build naming a phase
+        this owner doesn't know) sheds the entry instead of crashing the
+        flusher."""
+        plane, reg = _owner_plane()
+        nb = len(PHASE_BOUNDS) + 1
+        payload = pw._ophase.pack(250, 1_000_000, 1, 0.001, 0.001)
+        payload += struct.pack(f"<{nb}I", *([1] + [0] * (nb - 1)))
+        plane._apply_obs_record(0, pw.O_PHASE, payload)
+        snap = reg.snapshot()
+        # nothing folded anywhere: every phase counter (the inline
+        # cores' shard_view pre-creates the shardN names at zero) stays
+        # untouched
+        assert not any(
+            v for k, v in snap.items()
+            if k.startswith("phase_") and k.endswith("_ns")
+        )
+
+    def test_fold_records_accumulate_samples(self):
+        """O_FOLD records are additive increments (the worker resets its
+        sampler after each ship): stacks sum, samples sum."""
+        payload = (5).to_bytes(8, "little") + b"a;b 3\nc 2"
+        plane, _reg = _owner_plane()
+        plane._apply_obs_record(0, pw.O_FOLD, payload)
+        plane._apply_obs_record(0, pw.O_FOLD, payload)
+        assert plane.worker_fold_samples() == 10
+        folds = dict(plane.worker_folds())
+        assert folds["shard0/"] == {"a;b": 6, "c": 4}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: live process fleet, surfaces see through the boundary
+
+
+class TestProcessObsE2E:
+    @pytest.mark.asyncio
+    async def test_surfaces_see_through_process_boundary(self):
+        """One process-mode fleet, four assertions the satellites hang
+        off: (1) worker leaf phases (verify_wait included) fold into the
+        shardN counters /statusz exports, (2) /debugz interleaves worker
+        recorder events by mono time, (3) the fanned-out profiler merges
+        shardN/-prefixed worker frames, (4) a crashed worker's snapshot
+        carries the post-mortem obs drain into incident bundles."""
+        from at2_node_tpu.tools.incident import build_bundle
+
+        cfgs = make_net_configs(
+            3, _ports, plane=PlaneConfig(shards=2, executor="process")
+        )
+        services = [await Service.start(c) for c in cfgs]
+        try:
+            victim = services[0]
+            assert victim.broadcast._obs_ship
+
+            senders = [SignKeyPair.random() for _ in range(4)]
+            n_tx = 0
+            for sender in senders:
+                for seq in (1, 2):
+                    await services[0].broadcast.broadcast(
+                        make_payload(sender, seq=seq)
+                    )
+                    n_tx += 1
+
+            async def all_committed():
+                return all(s.committed >= n_tx for s in services)
+
+            await wait_until(
+                all_committed, timeout=60.0,
+                what="commits through the process plane",
+            )
+
+            # (1) worker phase accounting folded under shardN names; the
+            # verify term runs INSIDE the workers and must be attributed
+            async def phases_folded():
+                st = victim.snapshot_stats()
+                return all(
+                    sum(
+                        st.get(f"phase_{p}_shard{k}_ns", 0)
+                        for k in range(2)
+                    ) > 0
+                    for p in ("verify_wait", "rx_decode", "ready_deliver")
+                )
+
+            await wait_until(
+                phases_folded, timeout=15.0,
+                what="worker phase deltas fold into shardN counters",
+            )
+            st = victim.snapshot_stats()
+            assert st.get("obs_records_dropped", -1) == 0
+            assert (
+                sum(
+                    st.get(f"phase_plane_total_shard{k}_ns", 0)
+                    for k in range(2)
+                ) > 0
+            )
+
+            # (2) /debugz interleaves worker recorder events by mono t
+            async def worker_events_seen():
+                rec = victim.debugz()["recorder"]
+                return rec.get("worker_events", 0) > 0
+
+            await wait_until(
+                worker_events_seen, timeout=15.0,
+                what="worker recorder events reach /debugz",
+            )
+            dump = victim.debugz()["recorder"]
+            shard_events = [
+                e for e in dump["events"]
+                if str(e[1]).startswith("shard")
+            ]
+            assert shard_events
+            ts = [e[0] for e in dump["events"]]
+            assert ts == sorted(ts)
+
+            # (3) profiler fan-out: merged folded output carries worker
+            # frames under their shardN/ prefix
+            plane = victim._plane_obs()
+            assert plane is not None and plane.profiler_start()
+            deadline_tx = n_tx
+            for seq in (3, 4):
+                for sender in senders:
+                    await services[0].broadcast.broadcast(
+                        make_payload(sender, seq=seq)
+                    )
+                    deadline_tx += 1
+            await asyncio.sleep(1.2)
+            assert plane.profiler_stop()
+
+            async def folds_shipped():
+                return plane.worker_fold_samples() > 0
+
+            await wait_until(
+                folds_shipped, timeout=15.0,
+                what="worker folded-stack increments ship",
+            )
+            merged = victim._merged_folded(plane, None)
+            assert any(
+                line.startswith("shard") for line in merged.splitlines()
+            )
+
+            # (4) crash forensics: post-mortem drain + snapshot extra,
+            # riding /debugz into a deterministic incident bundle
+            victim.broadcast._executor.actions[0].put(
+                pw.C_EXIT, bytes([7])
+            )
+
+            async def crash_seen():
+                return victim.broadcast.worker_crashed == {0: 7}
+
+            await wait_until(
+                crash_seen, timeout=30.0,
+                what="owner detects the dead worker",
+            )
+            snaps = [
+                s for s in victim.recorder.dump()["snapshots"]
+                if s["reason"].startswith("plane_worker_crash:shard=0")
+            ]
+            assert snaps
+            extra = snaps[-1].get("extra")
+            assert extra is not None
+            assert extra["shard"] == 0 and extra["exit"] == 7
+            assert extra["recorder_tail"], "post-mortem tail empty"
+            assert any(
+                p in extra["phases"] for p in PLANE_LEAF_PHASES
+            )
+            bundle = build_bundle(
+                {"nodes": {"n0:1": {"debugz": victim.debugz()}}},
+                reason="test",
+            )
+            blob = bundle["files"]["n0_1/debugz.json"]
+            assert b"plane_worker_crash:shard=0" in blob
+            assert b"recorder_tail" in blob
+        finally:
+            for s in services:
+                await s.close()
+        # clean shutdown unlinks the obs rings with the others
+        for svc in services:
+            ex = svc.broadcast._executor
+            assert ex.actions == [] and ex.effects == [] and ex.obs == []
+
+
+# ---------------------------------------------------------------------------
+# determinism: the obs lane must not observe-ably exist under the sim
+
+
+class TestExecutorHashWithObs:
+    def test_campaign_hash_invariant_with_obs_tier_on(self):
+        """The sim forces inline execution under a non-system clock, so
+        the obs shipping lane never engages and the campaign hash stays
+        executor-invariant WITH the full observability tier enabled
+        (the sim default) — the same seam TestExecutorHashSweep pins,
+        re-asserted here because this PR grew what executor=process
+        would otherwise do."""
+        kw = dict(n_events=6, duration=5.0, settle_horizon=40.0)
+        mono = run_episode(3, **kw)
+        assert mono.violations == []
+        proc = run_episode(
+            3,
+            config_overrides={
+                "plane_shards": 2,
+                "plane_executor": "process",
+            },
+            **kw,
+        )
+        assert proc.violations == []
+        assert proc.trace_hash == mono.trace_hash
