@@ -75,6 +75,15 @@ CONFIG_TX = 14  # (epoch, admin signature, JSON change description)
 # never false-positive, while a real ledger divergence conflicts at an
 # identical coordinate and flips /healthz to `diverged` with attribution.
 BEACON = 15  # (epoch, commits, wm/account/directory digests, chain head)
+# Finality co-signature (finality/): a node's signature over the
+# CANONICAL frontier tuple (epoch, watermark digest, account-range
+# lanes, directory digest) — the subset of a beacon every correct node
+# reproduces byte-identically at the same committed set. The node-local
+# `commits` count rides along unsigned (a lag/progress coordinate for
+# operators and wait_final(); it differs across correct nodes and must
+# never enter the preimage). CertAssembler folds 2f+1 of these into a
+# quorum certificate a stateless light client can verify offline.
+CERT_SIG = 16  # (epoch, commits, wm/account/directory digests, co-sig)
 
 _PAYLOAD = struct.Struct("<32sI32sQ64s")  # sender, seq, recipient, amount, sig
 _ATTEST = struct.Struct("<32s32sI32s64s")  # origin, sender, seq, hash, sig
@@ -92,6 +101,14 @@ _CONFIG_HDR = struct.Struct("<QI64s")  # epoch, body length, admin sig
 # origin, epoch, commits, wm digest (16B), 16 u64 account-range lanes
 # (128B), directory digest (8B), local chain head (32B); + 64B signature
 _BEACON_BODY = struct.Struct("<32sQQ16s128s8s32s")
+# origin, epoch, commits, wm digest (16B), 16 u64 account-range lanes
+# (128B), directory digest (8B); + 64B co-signature. No chain head: only
+# the canonical (cross-node identical) fields belong in a certificate.
+_CERT_BODY = struct.Struct("<32sQQ16s128s8s")
+# The signed preimage of a co-signature covers ONLY the canonical tuple
+# (epoch, wm, ranges, dir) — not origin (the multi-sig scheme binds the
+# signer via its verification key) and not commits (node-local).
+_CERT_PREIMAGE = struct.Struct("<Q16s128s8s")
 
 PAYLOAD_WIRE = 1 + _PAYLOAD.size
 ATTEST_WIRE = 1 + _ATTEST.size
@@ -106,6 +123,7 @@ BATCH_REQ_WIRE = 1 + _BATCH_REQ.size
 DIR_HDR_WIRE = 1 + _DIR_HDR.size  # variable: header + count entries
 CONFIG_HDR_WIRE = 1 + _CONFIG_HDR.size  # variable: header + JSON body
 BEACON_WIRE = 1 + _BEACON_BODY.size + 64  # fixed: body + origin signature
+CERT_SIG_WIRE = 1 + _CERT_BODY.size + 64  # fixed: body + co-signature
 
 # Bounds one announce's parse amplification (a full directory re-sync
 # splits across several announces).
@@ -135,6 +153,7 @@ _BECHO_TAG = b"at2-node-tpu/batch-echo/v1"
 _BREADY_TAG = b"at2-node-tpu/batch-ready/v1"
 _CONFIG_TAG = b"at2-node-tpu/config-tx/v1"
 _BEACON_TAG = b"at2-node-tpu/beacon/v1"
+_CERT_TAG = b"at2-node-tpu/cert/v1"
 
 
 class WireError(Exception):
@@ -758,6 +777,84 @@ class StateBeacon:
         )
 
 
+def cert_signing_bytes(
+    epoch: int, wm_digest: bytes, ranges: bytes, dir_digest: bytes
+) -> bytes:
+    """The canonical certificate preimage: every correct node at the
+    same committed frontier produces these exact bytes, so a quorum of
+    signatures over them is portable finality evidence. Deliberately
+    excludes the signer identity (bound by the verification key in the
+    attestation scheme) and every node-local field (commits, chain)."""
+    return _CERT_TAG + _CERT_PREIMAGE.pack(epoch, wm_digest, ranges, dir_digest)
+
+
+@dataclass(frozen=True)
+class CertSig:
+    """One node's finality co-signature over a canonical commit
+    frontier (finality/certs.py assembles 2f+1 of these into a quorum
+    certificate; TECHNICAL.md "Finality certificates").
+
+    ``epoch``/``wm_digest``/``ranges``/``dir_digest`` are the signed
+    canonical tuple — additive digests identical across correct nodes
+    at the same committed set (see StateBeacon). ``commits`` is the
+    origin's node-local committed-transfer count at the frontier:
+    informational (progress/lag coordinate), carried OUTSIDE the
+    preimage because correct nodes disagree on it."""
+
+    origin: bytes  # co-signing node's sign key
+    epoch: int  # membership epoch the frontier was taken under
+    commits: int  # node-local commit count (unsigned, informational)
+    wm_digest: bytes  # 16B additive watermark digest (the coordinate)
+    ranges: bytes  # 16 little-endian u64 account-range lanes (128B)
+    dir_digest: bytes  # 8B additive client-directory digest
+    signature: bytes  # origin ed25519 over cert_signing_bytes()
+
+    def to_sign(self) -> bytes:
+        return cert_signing_bytes(
+            self.epoch, self.wm_digest, self.ranges, self.dir_digest
+        )
+
+    @classmethod
+    def create(
+        cls,
+        keypair,
+        epoch: int,
+        commits: int,
+        wm_digest: bytes,
+        ranges: bytes,
+        dir_digest: bytes,
+    ) -> "CertSig":
+        sig = keypair.sign(
+            cert_signing_bytes(epoch, wm_digest, ranges, dir_digest)
+        )
+        return cls(
+            keypair.public, epoch, commits, wm_digest, ranges, dir_digest, sig
+        )
+
+    def encode(self) -> bytes:
+        return (
+            bytes([CERT_SIG])
+            + _CERT_BODY.pack(
+                self.origin,
+                self.epoch,
+                self.commits,
+                self.wm_digest,
+                self.ranges,
+                self.dir_digest,
+            )
+            + self.signature
+        )
+
+    @staticmethod
+    def decode_body(body: bytes) -> "CertSig":
+        origin, epoch, commits, wm, ranges, dird = _CERT_BODY.unpack(
+            body[: _CERT_BODY.size]
+        )
+        return CertSig(
+            origin, epoch, commits, wm, ranges, dird, body[_CERT_BODY.size :]
+        )
+
+
 def parse_frame(frame: bytes) -> list:
     """Split a frame into messages (frames may coalesce many)."""
     out = []
@@ -870,6 +967,11 @@ def parse_frame(frame: bytes) -> list:
                 raise WireError("truncated state beacon")
             out.append(StateBeacon.decode_body(bytes(view[1:BEACON_WIRE])))
             view = view[BEACON_WIRE:]
+        elif kind == CERT_SIG:
+            if len(view) < CERT_SIG_WIRE:
+                raise WireError("truncated cert co-signature")
+            out.append(CertSig.decode_body(bytes(view[1:CERT_SIG_WIRE])))
+            view = view[CERT_SIG_WIRE:]
         else:
             raise WireError(f"unknown message kind {kind}")
     return out

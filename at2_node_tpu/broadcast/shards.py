@@ -259,6 +259,7 @@ class ShardedPlane:
         self.directory_handler = None
         self.config_handler = None
         self.beacon_handler = None
+        self.cert_handler = None
         self.stall_handler = None
 
         self.stats = self.registry.counter_group((
@@ -1229,6 +1230,7 @@ class ShardedPlane:
             "directory_handler",
             "config_handler",
             "beacon_handler",
+            "cert_handler",
         ):
             for core in getattr(self, "_cores", ()):  # pre-init writes
                 setattr(core, name, value)

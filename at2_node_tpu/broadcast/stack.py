@@ -111,6 +111,7 @@ from .messages import (
     HistoryIndexRequest,
     HistoryRequest,
     Payload,
+    CertSig,
     StateBeacon,
     TxBatch,
     WireError,
@@ -531,6 +532,10 @@ class Broadcast:
         # (peer, msg) -> None; obs/audit.py) — same shape as
         # directory_handler; None drops them
         self.beacon_handler = None
+        # node-service hook for finality cert co-signatures (sync
+        # callable (peer, msg) -> None; finality/certs.py) — same shape
+        # as beacon_handler; None drops them
+        self.cert_handler = None
         # sim hook fired whenever this node SIGNS an attestation (either
         # plane): callable (phase, origin_or_sender, sequence, chash).
         # The simulator's no-post-restart-equivocation invariant records
@@ -1110,6 +1115,15 @@ class Broadcast:
                     self.beacon_handler(peer, msg)
                 except Exception:
                     logger.exception("beacon handler error")
+        elif isinstance(msg, CertSig):
+            # finality co-signatures (finality/certs.py); the assembler
+            # verifies the scheme signature — same cadence and routing
+            # shape as beacons
+            if self.cert_handler is not None:
+                try:
+                    self.cert_handler(peer, msg)
+                except Exception:
+                    logger.exception("cert handler error")
         else:
             if self._pre_attestation(msg, peer):
                 to_verify.append((msg.origin, msg.to_sign(), msg.signature))

@@ -159,6 +159,7 @@ constexpr uint8_t kGossip = 1, kEcho = 2, kReady = 3, kRequest = 4;
 constexpr uint8_t kHistIdxReq = 5, kHistIdx = 6, kHistReq = 7, kHistBatch = 8;
 constexpr uint8_t kBatch = 9, kBatchEcho = 10, kBatchReady = 11, kBatchReq = 12;
 constexpr uint8_t kDirAnnounce = 13, kConfigTx = 14, kBeacon = 15;
+constexpr uint8_t kCertSig = 16;
 constexpr size_t kPayloadWire = 1 + 140;
 constexpr size_t kAttestWire = 1 + 164;
 constexpr size_t kRequestWire = 1 + 68;
@@ -190,6 +191,9 @@ constexpr uint64_t kMaxConfigBytes = 4096;  // messages.MAX_CONFIG_BYTES
 // BEACON = 0x0f | origin(32) epoch(u64) commits(u64) wm(16) ranges(128)
 //                 dir(8) chain(32) sig(64) — fixed, messages.BEACON_WIRE
 constexpr size_t kBeaconWire = 1 + 232 + 64;
+// CERT_SIG = 0x10 | origin(32) epoch(u64) commits(u64) wm(16) ranges(128)
+//                   dir(8) sig(64) — fixed, messages.CERT_SIG_WIRE
+constexpr size_t kCertSigWire = 1 + 200 + 64;
 constexpr size_t kMinWire = kHistIdxReqWire;  // smallest message on the wire
 // A legitimate frame coalesces at most MAX_BATCH_MSGS = 1024 messages
 // (net/peers.py); 4x that is the malformed-frame bound. Without it a
@@ -317,6 +321,8 @@ static int64_t parse_frames_impl(const uint8_t* flat, const uint64_t* offsets,
         wire = kConfigHdrWire + size_t(body_len);
       } else if (kind == kBeacon) {
         wire = kBeaconWire;  // fixed but wider than kRowStride
+      } else if (kind == kCertSig) {
+        wire = kCertSigWire;  // fixed but wider than kRowStride
       } else { ok = false; break; }
       if (left < wire) { ok = false; break; }
       if (n_out - start >= kMaxMsgsPerFrame) { ok = false; break; }
@@ -325,9 +331,9 @@ static int64_t parse_frames_impl(const uint8_t* flat, const uint64_t* offsets,
       row[0] = kind;
       if (kind == kHistIdx || kind == kHistBatch || kind == kBatch ||
           kind == kBatchEcho || kind == kBatchReady || kind == kDirAnnounce ||
-          kind == kConfigTx || kind == kBeacon) {
-        // variable-length kinds (and the beacon, whose fixed 296-byte
-        // body is wider than kRowStride): row carries (offset, length)
+          kind == kConfigTx || kind == kBeacon || kind == kCertSig) {
+        // variable-length kinds (and the beacon/cert co-sig, whose fixed
+        // bodies are wider than kRowStride): row carries (offset, length)
         // into `flat`
         put_le64(row + 1, uint64_t(p + 1 - flat));
         put_le64(row + 9, uint64_t(wire - 1));

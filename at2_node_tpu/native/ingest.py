@@ -30,6 +30,7 @@ from ..broadcast.messages import (
     BATCH_READY,
     BATCH_REQ,
     BEACON,
+    CERT_SIG,
     CONFIG_TX,
     DIR_ANNOUNCE,
     ECHO,
@@ -46,6 +47,7 @@ from ..broadcast.messages import (
     Attestation,
     BatchAttestation,
     BatchContentRequest,
+    CertSig,
     ConfigTx,
     ContentRequest,
     DirectoryAnnounce,
@@ -246,10 +248,10 @@ def _build_rows(rows, msg_frame, flat, n: int, stride: int):
             msg = BatchContentRequest.decode_body(row_bytes[base + 1 : base + 73])
         elif kind in (
             HIST_IDX, HIST_BATCH, BATCH, BATCH_ECHO, BATCH_READY,
-            DIR_ANNOUNCE, CONFIG_TX, BEACON,
+            DIR_ANNOUNCE, CONFIG_TX, BEACON, CERT_SIG,
         ):
             # variable-length rows carry (offset, length) into `flat`
-            # (BEACON is fixed-size but wider than the row stride)
+            # (BEACON/CERT_SIG are fixed-size but wider than the row stride)
             off = int.from_bytes(row_bytes[base + 1 : base + 9], "little")
             ln = int.from_bytes(row_bytes[base + 9 : base + 17], "little")
             body = flat[off : off + ln].tobytes()
@@ -261,6 +263,8 @@ def _build_rows(rows, msg_frame, flat, n: int, stride: int):
                 msg = ConfigTx.decode_body(body)
             elif kind == BEACON:
                 msg = StateBeacon.decode_body(body)
+            elif kind == CERT_SIG:
+                msg = CertSig.decode_body(body)
             elif kind == DIR_ANNOUNCE:
                 origin, _count = _DIR_HDR.unpack_from(body)
                 msg = DirectoryAnnounce.decode_body(origin, body[_DIR_HDR.size :])

@@ -33,6 +33,7 @@ from typing import Dict, Optional, Tuple
 import grpc
 
 from ..proto import at2_pb2 as pb
+from ..proto import finality_pb2 as fpb
 
 logger = logging.getLogger(__name__)
 
@@ -66,6 +67,7 @@ _REQUEST_TYPES: Dict[str, type] = {
     "GetBalance": pb.GetBalanceRequest,
     "GetLastSequence": pb.GetLastSequenceRequest,
     "GetLatestTransactions": pb.GetLatestTransactionsRequest,
+    "GetCertificate": fpb.GetCertificateRequest,
 }
 
 _CORS_HEADERS = (

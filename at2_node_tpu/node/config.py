@@ -550,6 +550,41 @@ class OverloadConfig:
 
 
 @dataclass
+class FinalityConfig:
+    """The `[finality]` table: succinct finality certificates
+    (finality/, TECHNICAL.md "Finality certificates").
+
+    ``enabled = false`` (the default) keeps the subsystem fully inert:
+    no kind-16 co-signatures are emitted, no assembler state is kept,
+    and the wire schedule — and therefore every same-seed sim/campaign
+    hash — is byte-identical to a build without this table (hash-gated
+    in CI, same bar as `[wan]` and `[overload]`).
+
+    When enabled, every ``observability.audit_every`` commit frontier
+    the node broadcasts a co-signature over the canonical
+    (epoch, watermark digest, range lanes, directory digest) tuple;
+    the assembler folds ``quorum`` of them (0 derives the AT2 default
+    2f+1 from the member count) into a certificate under the named
+    attestation ``scheme`` (finality/scheme.py registry — multi_eddsa
+    today, the BLS aggregate slots in here later). ``history`` bounds
+    the certificate chain tail retained in memory, the store manifest,
+    and /certz."""
+
+    enabled: bool = False
+    scheme: str = "multi_eddsa"
+    quorum: int = 0  # 0 = derive 2f+1 from the membership size
+    history: int = 8
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.scheme, str) or not self.scheme:
+            raise ValueError("finality.scheme must be a non-empty string")
+        if self.quorum < 0:
+            raise ValueError("finality.quorum must be >= 0")
+        if self.history < 1:
+            raise ValueError("finality.history must be >= 1")
+
+
+@dataclass
 class Config:
     node_address: str
     rpc_address: str
@@ -570,6 +605,7 @@ class Config:
     plane: PlaneConfig = field(default_factory=PlaneConfig)
     wan: WanConfig = field(default_factory=WanConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
+    finality: FinalityConfig = field(default_factory=FinalityConfig)
     echo_threshold: Optional[int] = None
     ready_threshold: Optional[int] = None
 
@@ -735,6 +771,16 @@ class Config:
                 f"brownout_frac = {ov.brownout_frac}",
                 f"refuse_frac = {ov.refuse_frac}",
             ]
+        fi = self.finality
+        if fi != FinalityConfig():
+            lines += [
+                "",
+                "[finality]",
+                f"enabled = {'true' if fi.enabled else 'false'}",
+                f'scheme = "{fi.scheme}"',
+                f"quorum = {fi.quorum}",
+                f"history = {fi.history}",
+            ]
         for peer in self.nodes:
             lines += [
                 "",
@@ -762,6 +808,7 @@ class Config:
         plane = PlaneConfig(**doc.get("plane", {}))
         wan = WanConfig(**doc.get("wan", {}))
         overload = OverloadConfig(**doc.get("overload", {}))
+        finality = FinalityConfig(**doc.get("finality", {}))
         return Config(
             node_address=doc["addresses"]["node"],
             rpc_address=doc["addresses"]["rpc"],
@@ -788,6 +835,7 @@ class Config:
             plane=plane,
             wan=wan,
             overload=overload,
+            finality=finality,
             echo_threshold=doc.get("echo_threshold"),
             ready_threshold=doc.get("ready_threshold"),
         )

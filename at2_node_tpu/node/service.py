@@ -36,6 +36,7 @@ import grpc
 
 from ..broadcast.messages import (
     MAX_BATCH_ENTRIES,
+    CertSig,
     DirectoryAnnounce,
     HistoryBatch,
     HistoryIndex,
@@ -48,6 +49,7 @@ from ..broadcast.messages import (
 from ..broadcast.stack import Broadcast
 from ..crypto.keys import verify_one
 from ..crypto.verifier import Verifier
+from ..finality import CertAssembler
 from ..ledger import checkpoint as ckpt
 from ..ledger import history as hist
 from ..ledger.accounts import AccountModificationError, Accounts
@@ -67,6 +69,7 @@ from ..obs.slo import SloEngine, default_objectives
 from ..obs.trace import REJECTED, TxTrace
 from ..proto import at2_pb2 as pb
 from ..proto import distill
+from ..proto import finality_pb2 as fpb
 from ..proto.rpc import At2Servicer, add_to_server
 from ..types import (
     TRANSFER_SIG_TAG,
@@ -457,7 +460,8 @@ class Service(At2Servicer):
         # (_commit_tail, deterministic under sim) plus a wall timer on
         # served nodes (start()).
         self.auditor = FleetAuditor(
-            self.accounts.digest, history_cap=obs.audit_history
+            self.accounts.digest, history_cap=obs.audit_history,
+            clock=self.clock,
         )
         # sim failpoint (sim/campaign.py planted_divergence_episode):
         # callable (payload) -> balance delta misapplied to the
@@ -473,6 +477,28 @@ class Service(At2Servicer):
             "audit_commits", "commits folded into the local digest chain",
             fn=lambda: self.auditor.commits,
         )
+        # Finality certificates (finality/, config [finality]): the
+        # assembler collects kind-16 co-signatures into quorum certs.
+        # None when the table is absent/disabled — the subsystem is
+        # fully inert and the wire schedule stays byte-identical.
+        fin = config.finality
+        self.certs: Optional[CertAssembler] = None
+        if fin.enabled:
+            self.certs = CertAssembler(
+                list(self._node_ranks),
+                epoch=0,
+                scheme=fin.scheme,
+                quorum=fin.quorum,
+                history=fin.history,
+            )
+            self.registry.register_provider("finality_", self.certs.stats)
+            self.registry.gauge(
+                "finality_equivocation",
+                "1 when the assembler holds a latched cert equivocation",
+                fn=lambda: (
+                    1 if self.certs.equivocation is not None else 0
+                ),
+            )
 
     # -- lifecycle --------------------------------------------------------
 
@@ -609,6 +635,7 @@ class Service(At2Servicer):
             service.broadcast.catchup_handler = service._on_catchup
             service.broadcast.directory_handler = service._on_directory
             service.broadcast.beacon_handler = service._on_beacon
+            service.broadcast.cert_handler = service._on_cert_sig
             if service.store is not None:
                 # broadcast-safety floors: the slots this node attested
                 # before the crash are fenced — a restarted node never
@@ -945,6 +972,11 @@ class Service(At2Servicer):
         # (Accounts.import_state / ClientDirectory.apply maintain them);
         # resume the persisted local chain head with a restart marker
         self.auditor.restore(store.audit)
+        if self.certs is not None:
+            # resume the persisted certificate chain (and any latched
+            # equivocation evidence) at the epoch the store reached
+            self.certs.restore(store.finality)
+            self.certs.epoch = store.epoch
         # refill the catchup serving store from persisted history so a
         # restarted node can serve peers (and the conservation invariant
         # can replay) without waiting for new commits
@@ -1010,6 +1042,9 @@ class Service(At2Servicer):
             distill_seen=[[cid, seq] for cid, seq in seen],
             epoch=self.membership.epoch if self.membership else None,
             audit=self.auditor.export(),
+            finality=(
+                self.certs.export() if self.certs is not None else None
+            ),
         )
         stats = self.store.flush()
         if stats:
@@ -1061,6 +1096,12 @@ class Service(At2Servicer):
         if not self.membership.handle(tx):
             return
         self.recovery.epoch = self.membership.epoch
+        if self.certs is not None:
+            # certificates name their epoch: pending co-signature
+            # buckets from the old epoch can never reach quorum under
+            # the new one, so the assembler drops them; the assembled
+            # chain survives the transition
+            self.certs.reconfigure(self.certs.members, self.membership.epoch)
         if self.store is not None:
             self.store.set_meta(epoch=self.membership.epoch)
         self.recorder.record("config_apply", (self.membership.epoch,))
@@ -1258,6 +1299,14 @@ class Service(At2Servicer):
                     k, _, v = part.partition("=")
                     params[k] = v
             return self.profilez(params)
+        if route == "/certz":
+            # finality certificate chain (finality/): kill-switched by
+            # the [finality] table — disabled means 404, the endpoint
+            # does not exist on this node
+            if self.certs is None:
+                return None
+            body = json.dumps(self.certz(), sort_keys=True).encode()
+            return 200, self._OBS_JSON, body
         if route == "/capturez":
             # inbound wire-capture ring (net/peers.py): kill-switched
             # like the flight recorder — capture_cap=0 (or a sim mesh,
@@ -1574,6 +1623,10 @@ class Service(At2Servicer):
             # fleet-audit block (obs/audit.py): digest lanes, chain
             # head, peer beacon summaries, and any latched divergence
             "audit": self.auditor.status(self.directory.digest),
+            # finality block (finality/certs.py): assembler counters,
+            # latest certificate, and the certified-vs-commit lag the
+            # top.py finality column renders
+            "finality": self._finality_status(),
             # sharded-plane block (tools/top.py `shards` column); the
             # monolithic plane has no plane_info and reports shards=1
             "plane": (
@@ -1882,6 +1935,10 @@ class Service(At2Servicer):
         so late peers' beacons at that watermark remain comparable)."""
         epoch = self.membership.epoch if self.membership is not None else 0
         point = self.auditor.snapshot(epoch, self.directory.digest)
+        # finality rides the same frontier: the co-signature covers the
+        # canonical subset of this very audit point (before the peer
+        # check — a single-node fleet still certifies locally)
+        self._emit_cert_sig(epoch, point)
         if self.mesh is None or not self.mesh.peers:
             return
         beacon = StateBeacon.create(
@@ -1929,6 +1986,90 @@ class Service(At2Servicer):
                 divergence["wm"][:16],
             )
             self.recorder.snapshot("audit_divergence")
+
+    # -- finality certificates (finality/) --------------------------------
+
+    def _emit_cert_sig(self, epoch: int, point: dict) -> None:
+        """Co-sign the canonical frontier tuple of a freshly-folded
+        audit point and gossip it (wire kind 16). The local co-signature
+        is folded into our own assembler first — we never hear our own
+        broadcast — which also lets a single-node fleet (quorum 1)
+        certify without any wire traffic."""
+        if self.certs is None:
+            return
+        cosig = CertSig.create(
+            self.config.sign_key,
+            epoch,
+            point["commits"],
+            point["wm"],
+            point["ranges"],
+            point["dir"],
+        )
+        self.certs.epoch = epoch
+        cert = self.certs.add(cosig)
+        if cert is not None:
+            self._note_certificate(cert)
+        if self.mesh is not None and self.mesh.peers:
+            self.mesh.broadcast(cosig.encode())
+
+    def _on_cert_sig(self, peer: Peer, msg: CertSig) -> None:
+        """Broadcast-plane hook for inbound cert co-signatures. Like
+        beacons, the TRANSPORT peer is deliberately not authenticated
+        against the origin — the assembler verifies the co-signature
+        against the claimed member key, and that signature alone binds
+        the claims (replayed captures still exercise the assembler)."""
+        if self.certs is None:
+            return
+        had_eq = self.certs.equivocation is not None
+        cert = self.certs.add(msg)
+        if cert is not None:
+            self._note_certificate(cert)
+        if not had_eq and self.certs.equivocation is not None:
+            eq = self.certs.equivocation
+            logger.warning(
+                "certificate equivocation: origin=%s epoch=%d wm=%s",
+                eq["origin"][:16], eq["epoch"], eq["wm"][:16],
+            )
+            self.recorder.snapshot("cert_equivocation")
+
+    def _note_certificate(self, cert) -> None:
+        logger.info(
+            "finality certificate: epoch=%d commits=%d signers=%d",
+            cert.epoch, cert.commits, cert.signer_count(),
+        )
+        self.recorder.record(
+            "certificate", (cert.epoch, cert.commits, cert.signer_count())
+        )
+
+    def _finality_status(self) -> dict:
+        """The /statusz finality block (tools/top.py finality column)."""
+        if self.certs is None:
+            return {"enabled": False}
+        latest = self.certs.latest
+        certified = latest.commits if latest is not None else 0
+        return {
+            "enabled": True,
+            "audit_every": self.config.observability.audit_every,
+            "frontier": self.auditor.commits,
+            "certified": certified,
+            "lag": max(0, self.auditor.commits - certified),
+            **self.certs.status(),
+        }
+
+    def certz(self) -> dict:
+        """GET /certz: the full light-client bundle — member keys,
+        quorum rule, and the retained certificate chain (oldest first).
+        Everything here is verifiable; nothing needs to be trusted."""
+        return {
+            "node": self.config.sign_key.public.hex(),
+            "epoch": self.certs.epoch,
+            "scheme": self.certs.scheme.name,
+            "quorum": self.certs.quorum,
+            "members": [k.hex() for k in self.certs.members],
+            "commits": self.auditor.commits,
+            "chain": [c.to_doc() for c in self.certs.chain],
+            "equivocation": self.certs.equivocation,
+        }
 
     async def _audit_beacon_loop(self, interval: float) -> None:
         """Wall-timer beacon emission for served nodes: an idle fleet
@@ -2540,6 +2681,23 @@ class Service(At2Servicer):
     async def GetLastSequence(self, request, context):
         sequence = await self.accounts.get_last_sequence(request.sender)
         return pb.GetLastSequenceReply(sequence=sequence)
+
+    async def GetCertificate(self, request, context):
+        """Finality lane (finality/): the retained certificate chain in
+        binary form plus this node's LIVE commit frontier — the frontier
+        lets wait_final() know when a future certificate must cover its
+        transfer (certificates are emitted at audit_every strides, so
+        one more stride always closes the gap)."""
+        if self.certs is None:
+            return fpb.GetCertificateReply(
+                enabled=False, node_commits=self.auditor.commits
+            )
+        return fpb.GetCertificateReply(
+            enabled=True,
+            epoch=self.certs.epoch,
+            node_commits=self.auditor.commits,
+            certificates=[c.encode() for c in self.certs.chain],
+        )
 
     async def GetLatestTransactions(self, request, context):
         txs = await self.recent.get_all()

@@ -146,9 +146,17 @@ class FleetAuditor:
     bounded foreign buffer and compared when the local point lands, so
     detection is symmetric regardless of who beacons first."""
 
-    def __init__(self, digest: LedgerDigest, history_cap: int = 512) -> None:
+    def __init__(
+        self, digest: LedgerDigest, history_cap: int = 512, clock=None
+    ) -> None:
         self.digest = digest
         self.history_cap = max(8, history_cap)
+        # monotonic-clock source (service injects its own, virtual under
+        # sim); only used to stamp the last matched-watermark comparison
+        # so /statusz can report beacon AGE — a silently-stalled audit
+        # loop shows as a growing age where counters alone look healthy
+        self.clock = clock
+        self.last_matched_mono: Optional[float] = None
         self.chain = bytes(32)
         self.commits = 0  # transfers folded since process start/restore
         self._points: "OrderedDict[bytes, dict]" = OrderedDict()
@@ -235,6 +243,8 @@ class FleetAuditor:
         self.counters["compared"] += 1
         if remote["ranges"] == local["ranges"]:
             self.counters["matched"] += 1
+            if self.clock is not None:
+                self.last_matched_mono = self.clock.monotonic()
             if remote["dir"] != local["dir"]:
                 self.counters["dir_skew"] += 1
             return None
@@ -263,8 +273,16 @@ class FleetAuditor:
     def stats(self) -> Dict[str, int]:
         return dict(self.counters)
 
+    def beacon_age(self) -> Optional[float]:
+        """Mono seconds since the last matched-watermark comparison;
+        None until the first match (or without a clock)."""
+        if self.clock is None or self.last_matched_mono is None:
+            return None
+        return max(0.0, self.clock.monotonic() - self.last_matched_mono)
+
     def status(self, dir_digest: int) -> dict:
         return {
+            "beacon_age_s": self.beacon_age(),
             "chain": self.chain.hex(),
             "commits": self.commits,
             "wm": self.digest.wm_bytes().hex(),

@@ -13,6 +13,7 @@ from __future__ import annotations
 import grpc
 
 from . import at2_pb2 as pb
+from . import finality_pb2 as fpb
 
 SERVICE_NAME = "at2.AT2"
 
@@ -31,6 +32,9 @@ _METHODS = {
     # batch submission (proto/distill.py wire format inside `frame`).
     "Register": (pb.RegisterRequest, pb.RegisterReply),
     "SendDistilledBatch": (pb.SendDistilledBatchRequest, pb.SendAssetReply),
+    # Finality lane (finality/): the certificate chain + the serving
+    # node's live commit frontier, for light clients and wait_final().
+    "GetCertificate": (fpb.GetCertificateRequest, fpb.GetCertificateReply),
 }
 
 
@@ -56,6 +60,9 @@ class At2Servicer:
         raise NotImplementedError
 
     async def SendDistilledBatch(self, request, context):
+        raise NotImplementedError
+
+    async def GetCertificate(self, request, context):
         raise NotImplementedError
 
 

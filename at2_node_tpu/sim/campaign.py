@@ -28,7 +28,12 @@ from ..crypto.keys import verify_one
 from ..proto import distill
 from ..types import transfer_signing_bytes
 from .fabric import LinkModel
-from .hostile import HostileFrameGen, SaltingClientGen, mutate_distilled_frame
+from .hostile import (
+    CertAdversary,
+    HostileFrameGen,
+    SaltingClientGen,
+    mutate_distilled_frame,
+)
 from .net import SimNet, sim_client
 
 # An event is [t, kind, args-dict] — JSON-shaped on purpose (banked by
@@ -425,6 +430,69 @@ def generate_salting_events(
     return events
 
 
+def generate_cert_events(
+    rng: random.Random,
+    *,
+    nodes: int = 4,
+    n_clients: int = 4,
+    n_events: int = 40,
+    duration: float = 20.0,
+    hostile: bool = True,
+) -> List[Event]:
+    """A finality-campaign schedule: serialized honest transfers (so
+    the commit frontier crosses several ``audit_every`` strides and
+    certificates actually assemble) with a byzantine member attacking
+    the certificate lane — equivocating co-signature pairs, off-epoch
+    co-signatures, forged signatures, and mutated kind-16 frames."""
+    stride = max(0.2, duration / max(1, n_events))
+    events: List[Event] = []
+    next_seq = [1] * n_clients
+    for k in range(n_events):
+        c = k % n_clients
+        events.append(
+            [
+                round(0.4 + stride * k, 3),
+                "tx",
+                {
+                    "node": rng.randrange(nodes),
+                    "client": c,
+                    "seq": next_seq[c],
+                    "to": (c + 1) % n_clients,
+                    "amount": 1 + rng.randint(0, 9),
+                },
+            ]
+        )
+        next_seq[c] += 1
+    if hostile:
+        targets = list(range(nodes))
+        for _ in range(3):
+            events.append(
+                [
+                    round(rng.uniform(1.0, duration), 3),
+                    "cert_equiv",
+                    {"targets": targets},
+                ]
+            )
+        for _ in range(2):
+            events.append(
+                [
+                    round(rng.uniform(1.0, duration), 3),
+                    "cert_stale",
+                    {"targets": targets, "epoch": 7},
+                ]
+            )
+        for _ in range(2):
+            events.append(
+                [
+                    round(rng.uniform(1.0, duration), 3),
+                    "cert_forge",
+                    {"targets": targets, "count": 4},
+                ]
+            )
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
 @dataclass
 class EpisodeResult:
     seed: int
@@ -514,6 +582,7 @@ def apply_events(
     clients: List,
     hostile_gen: Optional[HostileFrameGen],
     salting_gen: Optional[SaltingClientGen] = None,
+    cert_adv: Optional[CertAdversary] = None,
 ) -> None:
     """Schedule every event onto the net's virtual timeline (relative to
     now). Submissions go through the real SendAsset handler; rejections
@@ -806,6 +875,50 @@ def apply_events(
                 net.fabric.inject(src, node_sign(args["target"]), frame)
 
             loop.call_later(t, inject)
+        elif kind == "cert_equiv":
+            # byzantine member co-signs two conflicting ledger states at
+            # one (epoch, watermark): every receiver must latch the
+            # culprit and neither state may reach a certificate
+            if cert_adv is None:
+                continue
+
+            def cert_equiv(args=args):
+                fa, fb = cert_adv.equivocating_pair(args.get("epoch", 0))
+                for target in args["targets"]:
+                    dst = node_sign(target)
+                    net.fabric.inject(cert_adv.sign.public, dst, fa)
+                    net.fabric.inject(cert_adv.sign.public, dst, fb)
+
+            loop.call_later(t, cert_equiv)
+        elif kind == "cert_stale":
+            if cert_adv is None:
+                continue
+
+            def cert_stale(args=args):
+                frame = cert_adv.off_epoch(args.get("epoch", 7))
+                for target in args["targets"]:
+                    net.fabric.inject(
+                        cert_adv.sign.public, node_sign(target), frame
+                    )
+
+            loop.call_later(t, cert_stale)
+        elif kind == "cert_forge":
+            if cert_adv is None:
+                continue
+
+            def cert_forge(args=args):
+                for _ in range(args.get("count", 1)):
+                    frame = (
+                        cert_adv.forged()
+                        if cert_adv.rng.random() < 0.5
+                        else cert_adv.mutant()
+                    )
+                    for target in args["targets"]:
+                        net.fabric.inject(
+                            cert_adv.sign.public, node_sign(target), frame
+                        )
+
+            loop.call_later(t, cert_forge)
         elif kind == "misapply":
             # arm one node's ledger failpoint (node/service.py
             # _apply_pass): the next `count` successful transfers it
@@ -907,6 +1020,98 @@ def _salting_sweep(
     return violations
 
 
+def _cert_sweep(
+    net: SimNet, events: List[Event], adversary_pk: Optional[bytes]
+) -> List[str]:
+    """Finality-campaign invariants (checked at quiescence):
+
+    * certificate production is LIVE: every live node assembled at
+      least one certificate over the episode's commit frontier,
+    * every retained certificate passes FULL light verification
+      (finality/light.py members mode — bitmap, per-rank signatures,
+      quorum) and the chain never rolls progress back,
+    * the planted equivocation LATCHED on every live node with culprit
+      attribution (the adversary's key, both signed statements),
+    * no equivocating/forged/stale co-signature ever reached a
+      certificate: no two nodes hold certificates naming different
+      ledger states at the same (epoch, watermark), and the adversary
+      attacks show up in the defense counters, not the chain."""
+    from ..finality import LightVerifier, verify_chain
+
+    violations: List[str] = []
+    n_equiv = sum(1 for _t, k, _a in events if k == "cert_equiv")
+    n_stale = sum(1 for _t, k, _a in events if k == "cert_stale")
+    n_forge = sum(1 for _t, k, _a in events if k == "cert_forge")
+    adversary_hex = adversary_pk.hex() if adversary_pk else None
+    for si, svc in enumerate(net.services):
+        if si in net.down:
+            continue
+        certs = svc.certs
+        if certs is None:
+            violations.append(
+                f"finality: node {si} runs without an assembler despite "
+                "[finality] enabled"
+            )
+            continue
+        if certs.latest is None:
+            violations.append(
+                f"finality: node {si} assembled no certificate "
+                f"(commits={svc.auditor.commits}, "
+                f"counters={certs.counters})"
+            )
+        else:
+            lv = LightVerifier(
+                [], members=certs.members, quorum=certs.quorum
+            )
+            verdict = verify_chain(certs.chain, lv)
+            if not verdict["ok"]:
+                violations.append(
+                    f"finality: node {si} serves an unverifiable chain: "
+                    f"{verdict}"
+                )
+        if n_equiv:
+            eq = certs.equivocation
+            if eq is None:
+                violations.append(
+                    f"finality: node {si} never latched the planted "
+                    "certificate equivocation"
+                )
+            elif adversary_hex and eq.get("origin") != adversary_hex:
+                violations.append(
+                    f"finality: node {si} latched equivocation but "
+                    f"attributed {eq.get('origin', '')[:16]}… instead of "
+                    f"the adversary {adversary_hex[:16]}…"
+                )
+        if n_stale and not certs.counters.get("epoch_skew"):
+            violations.append(
+                f"finality: node {si} accepted or lost the off-epoch "
+                "co-signatures (epoch_skew == 0)"
+            )
+        if n_forge and not certs.counters.get("bad_sig"):
+            violations.append(
+                f"finality: node {si} accepted or lost the forged "
+                "co-signatures (bad_sig == 0)"
+            )
+    # cross-node: equal watermark digest ⇔ equal committed set, so two
+    # certificates naming different (ranges, dir) at one (epoch, wm)
+    # would mean an equivocating state was actually certified somewhere
+    seen: Dict[tuple, tuple] = {}
+    for si, svc in enumerate(net.services):
+        if si in net.down or svc.certs is None:
+            continue
+        for cert in svc.certs.chain:
+            key = (cert.epoch, cert.wm_digest)
+            state = (cert.ranges, cert.dir_digest)
+            prior = seen.setdefault(key, (si, state))
+            if prior[1] != state:
+                violations.append(
+                    "finality: conflicting certificates at epoch "
+                    f"{cert.epoch} wm {cert.wm_digest.hex()[:16]}… "
+                    f"(nodes {prior[0]} and {si})"
+                )
+    return violations
+
+
 def run_episode(
     seed: int,
     *,
@@ -926,6 +1131,7 @@ def run_episode(
     broker: bool = False,
     durability: bool = False,
     salting: bool = False,
+    finality: bool = False,
 ) -> EpisodeResult:
     """One self-contained episode: fresh SimNet, (generated or given)
     events, run + settle, invariant check, teardown. Pure in
@@ -949,7 +1155,13 @@ def run_episode(
     ``salting``: run the batch-poisoning flavor — the shared verifier in
     auto mode with a sim-sized RLC threshold, a schedule from
     :func:`generate_salting_events`, and the amortized-verification
-    invariant sweep (:func:`_salting_sweep`)."""
+    invariant sweep (:func:`_salting_sweep`).
+
+    ``finality``: run the certificate-lane flavor — every node with a
+    ``[finality]`` table and a sim-sized ``audit_every``, a schedule
+    from :func:`generate_cert_events` (honest load + a byzantine member
+    attacking the certificate lane), and the certificate invariant
+    sweep (:func:`_cert_sweep`)."""
     wall0 = time.monotonic()
     rng = random.Random(_seed_int("episode", seed))
     sim_kwargs = dict(config_overrides or {})
@@ -959,6 +1171,13 @@ def run_episode(
     if salting:
         sim_kwargs.setdefault("verifier_mode", "auto")
         sim_kwargs.setdefault("rlc_min_batch", 8)
+    if finality:
+        from ..node.config import FinalityConfig, ObservabilityConfig
+
+        sim_kwargs.setdefault("finality", FinalityConfig(enabled=True))
+        sim_kwargs.setdefault(
+            "observability", ObservabilityConfig(audit_every=8)
+        )
     net = SimNet(
         nodes,
         f,
@@ -978,6 +1197,8 @@ def run_episode(
                 generate = generate_broker_events
             elif salting:
                 generate = generate_salting_events
+            elif finality:
+                generate = generate_cert_events
             else:
                 generate = generate_events
             events = generate(
@@ -1001,7 +1222,15 @@ def run_episode(
             if salting
             else None
         )
-        apply_events(net, events, clients, hostile_gen, salting_gen)
+        cert_adv = (
+            CertAdversary(
+                net.hostile_configs[0].sign_key,
+                random.Random(_seed_int("certadv", seed)),
+            )
+            if finality and hostile > 0
+            else None
+        )
+        apply_events(net, events, clients, hostile_gen, salting_gen, cert_adv)
         last_t = max((e[0] for e in events), default=0.0)
         net.run_for(last_t + 1.0)
         net.fabric.heal_all()
@@ -1023,6 +1252,9 @@ def run_episode(
                 "wm": svc.accounts.digest.wm,
                 "ranges": list(svc.accounts.digest.ranges),
                 "dir": svc.directory.digest,
+                "finality": (
+                    svc.certs.status() if svc.certs is not None else None
+                ),
             }
             for svc in net.services
         ]
@@ -1032,6 +1264,11 @@ def run_episode(
         if salting:
             violations += _salting_sweep(
                 net, events, salting_gen.key.public
+            )
+        if finality:
+            violations += _cert_sweep(
+                net, events,
+                cert_adv.sign.public if cert_adv is not None else None,
             )
         if durability and net.down:
             # a schedule must always reboot what it kills; a node still
@@ -1174,6 +1411,40 @@ def planted_divergence_episode(
     )
 
 
+def planted_cert_equivocation_episode(
+    seed: int = 20260807, *, capture_obs: Optional[bool] = None
+) -> EpisodeResult:
+    """The canonical certificate-lane attack, as a one-call reproducer:
+    a 4-node fleet with finality enabled runs serialized honest
+    transfers, and a byzantine fleet MEMBER (its key in the epoch
+    member set, so its co-signatures verify) emits equivocating
+    co-signature pairs, off-epoch co-signatures, and forged frames at
+    every node.
+
+    The episode PASSES iff the defense held: honest certificates
+    assembled and fully verify, every live node latched the
+    equivocation with the adversary's key and both signed statements as
+    evidence, the off-epoch/forged attacks landed in the
+    ``epoch_skew``/``bad_sig`` counters, and no conflicting state was
+    ever certified anywhere. scripts/ci.sh runs this twice and compares
+    trace hashes (the determinism gate) and asserts the latch +
+    attribution on every node's ``audit[i]["finality"]`` block."""
+    rng = random.Random(_seed_int("cert-planted", seed))
+    events = generate_cert_events(
+        rng, nodes=4, n_clients=4, n_events=40, duration=16.0, hostile=True
+    )
+    return run_episode(
+        seed,
+        nodes=4,
+        f=1,
+        hostile=1,
+        events=events,
+        finality=True,
+        settle_horizon=60.0,
+        capture_obs=capture_obs,
+    )
+
+
 def minimize_events(
     events: List[Event],
     failing: Callable[[List[Event]], bool],
@@ -1225,6 +1496,7 @@ def run_campaign(
     broker: bool = False,
     durability: bool = False,
     salting: bool = False,
+    finality: bool = False,
     config_overrides: Optional[dict] = None,
 ) -> dict:
     """``episodes`` independent seeded episodes; per-episode seeds derive
@@ -1236,7 +1508,10 @@ def run_campaign(
     ``durability=True`` the crash/restart/reconfig flavor (durable
     stores + membership + no-post-restart-equivocation);
     ``salting=True`` the batch-poisoning flavor (amortized verification
-    under a salting client + bounded-loss/router-convergence sweep)."""
+    under a salting client + bounded-loss/router-convergence sweep);
+    ``finality=True`` the certificate-lane flavor (finality enabled
+    fleet-wide + a byzantine member attacking the lane + the
+    certificate invariant sweep)."""
     camp_rng = random.Random(_seed_int("campaign", seed))
     results: List[EpisodeResult] = []
     for ep in range(episodes):
@@ -1252,6 +1527,7 @@ def run_campaign(
             broker=broker,
             durability=durability,
             salting=salting,
+            finality=finality,
             config_overrides=config_overrides,
         )
         if result.violations and minimize:
@@ -1269,6 +1545,7 @@ def run_campaign(
                         broker=broker,
                         durability=durability,
                         salting=salting,
+                        finality=finality,
                         config_overrides=config_overrides,
                     ).violations
                 ),
@@ -1288,6 +1565,7 @@ def run_campaign(
         "broker": broker,
         "durability": durability,
         "salting": salting,
+        "finality": finality,
         "campaign_hash": h.hexdigest(),
         "failures": sum(1 for r in results if not r.ok),
         "results": [r.to_dict() for r in results],

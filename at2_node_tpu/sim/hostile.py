@@ -27,6 +27,7 @@ from ..broadcast.messages import (
     READY,
     Attestation,
     BatchAttestation,
+    CertSig,
     ContentRequest,
     DirectoryAnnounce,
     HistoryBatch,
@@ -62,6 +63,31 @@ def mutate_distilled_frame(frame: bytes, rng: random.Random) -> bytes:
         b[3] = rng.choice((0x00, 0x7F, 0x80, 0xFF))
     else:  # pure garbage
         return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 200)))
+    return bytes(b)
+
+
+def mutate_cert_frame(frame: bytes, rng: random.Random) -> bytes:
+    """One hostile mutation of a well-formed kind-16 certificate
+    co-signature frame (broadcast/messages.py CertSig). Same contract
+    as :func:`mutate_distilled_frame`: mutants are not guaranteed
+    malformed — a flip inside the 64-byte signature tail parses fine
+    and must then fail the assembler's per-cosig verification
+    (``bad_sig``), while kind stomps and truncations must die in the
+    frame parser without desyncing the frames behind them."""
+    choice = rng.randrange(6)
+    b = bytearray(frame)
+    if choice == 0 and b:  # kind stomp: reroute to another parser
+        b[0] ^= rng.choice((0x01, 0x10, 0xFF))
+    elif choice == 1 and len(b) > 1:  # truncation
+        del b[rng.randint(1, len(b) - 1):]
+    elif choice == 2:  # trailing junk (wire-size discipline must catch)
+        b.extend(rng.getrandbits(8) for _ in range(rng.randint(1, 64)))
+    elif choice == 3 and len(b) > 65:  # body flip: epoch/wm/ranges/dir
+        b[rng.randrange(1, len(b) - 64)] ^= 1 << rng.randrange(8)
+    elif choice == 4 and len(b) >= 64:  # signature flip: parses, bad sig
+        b[rng.randrange(len(b) - 64, len(b))] ^= 1 << rng.randrange(8)
+    else:  # pure garbage
+        return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 300)))
     return bytes(b)
 
 
@@ -336,3 +362,66 @@ class HostileFrameGen:
             frame = self._malformed()
         self.sent_log.append(frame)
         return frame
+
+
+class CertAdversary:
+    """Byzantine fleet MEMBER attacking the finality-certificate lane
+    (finality/certs.py): its sign key is in the epoch member set, so
+    its kind-16 co-signatures verify — the attacks below are exactly
+    the ones a single compromised member can mount, and the assembler
+    must defang every one without help from the honest majority.
+
+    Pure like the other generators: seeded rng in, deterministic frames
+    out; the sim injects them through ``SimFabric.inject``."""
+
+    def __init__(self, sign_key: SignKeyPair, rng: random.Random):
+        self.sign = sign_key
+        self.rng = rng
+
+    def _digests(self):
+        rng = self.rng
+        wm = bytes(rng.getrandbits(8) for _ in range(16))
+        ranges = bytes(rng.getrandbits(8) for _ in range(128))
+        dird = bytes(rng.getrandbits(8) for _ in range(8))
+        return wm, ranges, dird
+
+    def equivocating_pair(self, epoch: int = 0) -> tuple:
+        """Two VALIDLY SIGNED co-signatures for the same (epoch,
+        watermark) naming different ledger states — cryptographic
+        equivocation. The receiving assembler must latch the culprit
+        with both signed statements, and neither statement may ever
+        reach a certificate (an honest quorum never co-signs either
+        fabricated state)."""
+        wm, ranges, dird = self._digests()
+        commits = self.rng.getrandbits(16)
+        a = CertSig.create(self.sign, epoch, commits, wm, ranges, dird)
+        ranges2 = bytes(x ^ 0xFF for x in ranges)
+        b = CertSig.create(self.sign, epoch, commits, wm, ranges2, dird)
+        return a.encode(), b.encode()
+
+    def off_epoch(self, epoch: int) -> bytes:
+        """A validly signed co-signature at a stale (or future) epoch:
+        counted as ``epoch_skew`` and never bucketed — a pre-reconfig
+        member cannot vote under the new epoch's quorum rule."""
+        wm, ranges, dird = self._digests()
+        return CertSig.create(
+            self.sign, epoch, self.rng.getrandbits(16), wm, ranges, dird
+        ).encode()
+
+    def forged(self, epoch: int = 0) -> bytes:
+        """A well-formed frame whose signature is garbage: survives the
+        wire parser, must die at the assembler's scheme verification
+        (``bad_sig``)."""
+        wm, ranges, dird = self._digests()
+        sig = bytes(self.rng.getrandbits(8) for _ in range(64))
+        return CertSig(
+            self.sign.public, epoch, self.rng.getrandbits(16),
+            wm, ranges, dird, sig,
+        ).encode()
+
+    def mutant(self, epoch: int = 0) -> bytes:
+        """A mutated kind-16 frame (wire fuzz: parser robustness)."""
+        base = self.off_epoch(epoch) if self.rng.random() < 0.5 else (
+            self.forged(epoch)
+        )
+        return mutate_cert_frame(base, self.rng)

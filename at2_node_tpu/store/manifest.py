@@ -48,6 +48,8 @@ def empty_manifest() -> dict:
         "recent": [],
         "watermarks": {"tx": {}, "batch": {}},
         "distill_seen": [],
+        "audit": {},
+        "finality": {},  # certificate chain tail (finality/certs.py)
         "accounts_total": 0,
     }
 

@@ -96,6 +96,9 @@ class ShardedStore:
         # fleet-audit chain persistence (obs/audit.py export/restore):
         # {"chain": hex, "commits": int} — restart tamper evidence
         self.audit: dict = {}
+        # finality certificate-chain persistence (finality/certs.py
+        # export/restore): the assembled chain tail + equivocation latch
+        self.finality: dict = {}
         self.wal_replayed = 0  # records replayed by the last open()
         self.segments_loaded = 0  # segments read by the last open()
         self.migrated = False  # open() imported a legacy checkpoint
@@ -162,6 +165,7 @@ class ShardedStore:
         store.watermarks = doc.get("watermarks", {"tx": {}, "batch": {}})
         store.distill_seen = doc.get("distill_seen", [])
         store.audit = doc.get("audit", {})
+        store.finality = doc.get("finality", {})
         store._parked = dict.fromkeys(doc.get("parked", []))
         store._segments = dict(doc.get("segments", {}))
 
@@ -261,6 +265,7 @@ class ShardedStore:
         distill_seen: Optional[list] = None,
         epoch: Optional[int] = None,
         audit: Optional[dict] = None,
+        finality: Optional[dict] = None,
     ) -> None:
         """Refresh the small state the manifest carries (called by the
         service right before a flush)."""
@@ -276,6 +281,8 @@ class ShardedStore:
             self.epoch = epoch
         if audit is not None:
             self.audit = audit
+        if finality is not None:
+            self.finality = finality
         self._meta_dirty = True
 
     def flush(self, force: bool = False) -> Optional[dict]:
@@ -391,6 +398,7 @@ class ShardedStore:
             "watermarks": self.watermarks,
             "distill_seen": self.distill_seen,
             "audit": self.audit,
+            "finality": self.finality,
             "parked": list(self._parked),
             "accounts_total": self.account_count(),
         }

@@ -51,6 +51,7 @@ _SINGLE_FILES = (
     "BENCH_OBS_OVERHEAD.json",
     "BENCH_PLANE_SHARDS.json",
     "BENCH_OVERLOAD.json",
+    "BENCH_FINALITY.json",
 )
 
 
@@ -502,6 +503,44 @@ def load_overload(name: str, doc: dict) -> List[dict]:
     return rows
 
 
+def load_finality(name: str, doc: dict) -> List[dict]:
+    """BENCH_FINALITY.json: the finality-certificate bench. Production
+    lag (virtual-time, deterministic) and cert wire bytes are judged
+    lower-better; light-client verify rates higher-better. The sim half
+    must have run clean — a bench whose episode broke invariants is not
+    a measurement."""
+    _require(doc, "ok", name)
+    config = _require(doc, "config", name, dict)
+    production = _require(doc, "production", name, dict)
+    verify = _require(doc, "verify", name, dict)
+    if production.get("violations"):
+        raise SchemaError(
+            f"{name}.production: bench episode violated invariants"
+        )
+    comp = (
+        f"nodes={config.get('nodes')} audit_every={config.get('audit_every')} "
+        f"txs={config.get('txs')}"
+    )
+    rows = [
+        _row("finality/production.lag_p50_s", "current", 0,
+             _num(production, "lag_p50_s", f"{name}.production"), comp,
+             lower_better=True),
+        _row("finality/production.lag_p99_s", "current", 0,
+             _num(production, "lag_p99_s", f"{name}.production"), comp,
+             lower_better=True),
+        _row("finality/production.certificates", "current", 0,
+             _num(production, "certificates", f"{name}.production"), comp),
+        _row("finality/cert_wire_bytes", "current", 0,
+             _num(doc, "cert_wire_bytes", name), comp, lower_better=True),
+    ]
+    for mode in ("subset", "full"):
+        rows.append(
+            _row(f"finality/verify.{mode}_per_s", "current", 0,
+                 _num(verify, f"{mode}_per_s", f"{name}.verify"), comp)
+        )
+    return rows
+
+
 _SINGLE_LOADERS = {
     "BENCH_LASTGOOD.json": load_lastgood,
     "BENCH_AGGREGATE.json": load_aggregate,
@@ -512,6 +551,7 @@ _SINGLE_LOADERS = {
     "BENCH_OBS_OVERHEAD.json": load_obs_overhead,
     "BENCH_PLANE_SHARDS.json": load_plane_shards,
     "BENCH_OVERLOAD.json": load_overload,
+    "BENCH_FINALITY.json": load_finality,
 }
 
 _RUN_LOADERS = {

@@ -151,6 +151,26 @@ def _shed_rate(addr: str, sz: dict, now: float, prev) -> str:
     return f"{(_shed_basis(sz) - seen[2]) / (now - seen[0]):.1f}"
 
 
+def _finality_cell(sz: dict) -> str:
+    """The ``final`` column: latest certified commit watermark plus its
+    lag behind the node's own commit frontier, as ``certified~lag``; a
+    trailing ``!`` flags a lag beyond twice the beacon stride
+    (``audit_every``) — certificates should trail by at most one
+    frontier, so 2x means the lane is stalled (no quorum of
+    co-signatures arriving, e.g. a partitioned or equivocating fleet).
+    ``-`` when the node runs without a [finality] table."""
+    fin = sz.get("finality", {})
+    if not fin.get("enabled"):
+        return "-"
+    certified = _num(fin, "certified")
+    lag = _num(fin, "lag")
+    cell = f"{certified}~{lag}"
+    stride = _num(fin, "audit_every", 0)
+    if stride and lag > 2 * stride:
+        cell += "!"
+    return cell
+
+
 def _recovery_cell(recovery: dict) -> str:
     """Compact progress for the ``recovery`` column: the live stage plus
     the one counter that says how far along it is."""
@@ -178,7 +198,8 @@ def render_frame(rows, now: float, prev) -> str:
         f"{'lag p99':>9}"
         f"{'backlog':>9}{'press':>7}{'shed/s':>8}"
         f"{'dstl rx/ms/dd':>15}{'peers':>7}"
-        f"{'shards':>8}{'hot shard':>17}{'epoch':>7}  {'recovery':<16}"
+        f"{'shards':>8}{'hot shard':>17}{'final':>11}{'epoch':>7}  "
+        f"{'recovery':<16}"
     )
     lines = []
     # fleet build line: every distinct (git SHA, config hash) the nodes
@@ -246,6 +267,7 @@ def render_frame(rows, now: float, prev) -> str:
                 f"{_num(stats, 'broker_registrations'):>7}"
                 f"{'-':>8}"
                 f"{'-':>17}"
+                f"{'-':>11}"
                 f"{'-':>7}  {'-':<16}"
             )
             continue
@@ -338,6 +360,7 @@ def render_frame(rows, now: float, prev) -> str:
             f"{_num(health, 'peers_configured'):<2}"
             f"{shards_s:>8}"
             f"{_hot_shard_cell(addr, sz, prev):>17}"
+            f"{_finality_cell(sz):>11}"
             f"{_num(health, 'epoch'):>7}  "
             f"{_recovery_cell(sz.get('recovery', {})):<16}"
         )
@@ -403,13 +426,17 @@ async def _poll(addrs, timeout: float):
 
 
 def once_verdict(rows, recovery_deadline: float,
-                 lag_deadline: float = None) -> list:
+                 lag_deadline: float = None,
+                 cert_lag_deadline: float = None) -> list:
     """The ``--once`` gate: addresses (with reasons) that fail it.
     Down and degraded always fail; ``recovering`` fails only past
     ``recovery_deadline`` seconds of recovery elapsed time; with
     ``lag_deadline`` set, an otherwise-healthy node whose event-loop
-    lag p99 exceeds it (ms) fails too. Pure function of its inputs —
-    unit-testable."""
+    lag p99 exceeds it (ms) fails too; with ``cert_lag_deadline`` set,
+    a finality-enabled node whose certified watermark trails its commit
+    frontier by more than that many commits fails (nodes without a
+    [finality] table are exempt — the gate judges the lane only where
+    it exists). Pure function of its inputs — unit-testable."""
     bad = []
     for addr, sz in rows:
         if isinstance(sz, Exception):
@@ -425,6 +452,16 @@ def once_verdict(rows, recovery_deadline: float,
                 if isinstance(lag, (int, float)) and lag > lag_deadline:
                     bad.append(f"{addr} (event-loop lag p99 {lag:.2f}ms > "
                                f"{lag_deadline:g}ms deadline)")
+            if cert_lag_deadline is not None:
+                fin = sz.get("finality", {})
+                clag = fin.get("lag")
+                if fin.get("enabled") and isinstance(
+                    clag, (int, float)
+                ) and clag > cert_lag_deadline:
+                    bad.append(
+                        f"{addr} (certificate lag {clag:g} commits > "
+                        f"{cert_lag_deadline:g} deadline)"
+                    )
             continue
         if status == "recovering":
             elapsed = sz.get("recovery", {}).get("elapsed_s", 0.0)
@@ -483,7 +520,8 @@ async def run_profilez(addrs, duration: float, limit: int = 10,
 async def run(addrs, interval: float, once: bool, clear: bool,
               as_json: bool, out=None,
               recovery_deadline: float = 120.0,
-              lag_deadline: float = None) -> int:
+              lag_deadline: float = None,
+              cert_lag_deadline: float = None) -> int:
     out = out or sys.stdout
     prev: dict = {}
     while True:
@@ -522,7 +560,8 @@ async def run(addrs, interval: float, once: bool, clear: bool,
             # unreachable or self-reports degraded health — a fleet
             # where one node answers is not a healthy fleet. Recovering
             # nodes pass within the deadline (see once_verdict).
-            bad = once_verdict(rows, recovery_deadline, lag_deadline)
+            bad = once_verdict(rows, recovery_deadline, lag_deadline,
+                               cert_lag_deadline)
             if bad:
                 print(f"unhealthy: {', '.join(bad)}", file=sys.stderr)
             return 1 if bad else 0
@@ -563,6 +602,12 @@ def main(argv=None) -> int:
                     metavar="MS",
                     help="with --once: fail the gate when any node's "
                          "event-loop lag p99 exceeds this many ms")
+    ap.add_argument("--cert-lag-deadline", type=float, default=None,
+                    metavar="COMMITS",
+                    help="with --once: fail the gate when a "
+                         "finality-enabled node's certified watermark "
+                         "trails its commit frontier by more than this "
+                         "many commits")
     args = ap.parse_args(argv)
     addrs = [_parse_addr(a) for a in args.nodes]
     try:
@@ -579,7 +624,8 @@ def main(argv=None) -> int:
             run(addrs, args.interval, args.once,
                 clear=not args.no_clear, as_json=args.json,
                 recovery_deadline=args.recovery_deadline,
-                lag_deadline=args.lag_deadline)
+                lag_deadline=args.lag_deadline,
+                cert_lag_deadline=args.cert_lag_deadline)
         )
     except KeyboardInterrupt:
         return 0

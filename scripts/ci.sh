@@ -506,6 +506,110 @@ else
   echo "single-core host: skipping the process-mode scaling smoke"
 fi
 
+echo "== finality gate =="
+# Succinct finality certificates + stateless light client (ISSUE 20),
+# three contracts:
+#  1. the planted equivocation campaign — a compromised fleet member
+#     co-signs two conflicting digests for the same (epoch, watermark)
+#     coordinate, plus stale-epoch and forged-signature floods — must
+#     end with ZERO invariant violations, every honest node latching
+#     the equivocation with the culprit attributed by public key, and
+#     the campaign trace hash reproduced byte-identically run to run;
+#  2. a live simulated fleet's certificate chain must verify through
+#     the stateless light client (f+1 co-signer threshold) on every
+#     node, and the strict full-quorum verifier must reject byte-level
+#     mutants (digest flip, bitmap flip, truncated signature blob);
+#  3. off-identity: an all-defaults (disabled) [finality] table must
+#     produce a wire trace byte-identical to no table at all — same
+#     bar as the [wan] and [overload] knobs.
+python -m pytest tests/test_finality.py -q -m "not slow"
+python - <<'EOF'
+from at2_node_tpu.sim.campaign import planted_cert_equivocation_episode
+from at2_node_tpu.sim.net import sim_keypairs
+
+seed = 20260807
+r1 = planted_cert_equivocation_episode(seed)
+r2 = planted_cert_equivocation_episode(seed)
+assert r1.trace_hash == r2.trace_hash, (r1.trace_hash, r2.trace_hash)
+assert not r1.violations, r1.violations
+culprit = sim_keypairs(seed, 4)[0].public.hex()
+assert r1.audit is not None
+for a in r1.audit:
+    fin = a["finality"]
+    assert fin is not None and fin["chain_len"] > 0, fin
+    eq = fin.get("equivocation")
+    assert eq is not None, "equivocation not latched"
+    assert eq["origin"] == culprit, eq["origin"][:16]
+    assert fin["epoch_skew"] > 0 and fin["bad_sig"] > 0, fin
+print("planted equivocation: latched on every node, attributed to",
+      culprit[:16] + ", hash", r1.trace_hash[:16])
+EOF
+python - <<'EOF'
+import dataclasses
+
+from at2_node_tpu.finality import LightVerifier, verify_chain
+from at2_node_tpu.node.config import FinalityConfig, ObservabilityConfig
+from at2_node_tpu.sim.net import SimNet, sim_client, sim_keypairs
+
+seed, nodes = 7, 4
+net = SimNet(
+    nodes, 1, seed,
+    finality=FinalityConfig(enabled=True),
+    observability=ObservabilityConfig(audit_every=8),
+).start()
+try:
+    client = sim_client(seed, 0)
+    recipient = sim_client(seed, 1).public
+    for k in range(24):
+        net.submit(k % nodes, client, k + 1, recipient, 1)
+    net.settle(horizon=60.0)
+    for svc in net.services:
+        svc._emit_beacon()
+    net.settle(horizon=10.0)
+    keys = [sim_keypairs(seed, i)[0].public for i in range(nodes)]
+    light = LightVerifier(keys, total=nodes)  # f+1 co-signer threshold
+    full = LightVerifier([], members=keys)  # strict: every bitmap bit
+    total = 0
+    for svc in net.services:
+        chain = list(svc.certs.chain)
+        assert chain, svc.certs.status()
+        assert verify_chain(chain, light)["ok"]
+        assert verify_chain(chain, full)["ok"]
+        total += len(chain)
+    cert = list(net.services[0].certs.chain)[-1]
+    mutants = [
+        dataclasses.replace(cert, ranges=bytes(x ^ 0xFF
+                                               for x in cert.ranges)),
+        dataclasses.replace(
+            cert, bitmap=bytes([cert.bitmap[0] ^ 0x0F]) + cert.bitmap[1:]
+        ),
+        dataclasses.replace(cert, sigs=cert.sigs[:-64]),
+    ]
+    for i, bad in enumerate(mutants):
+        assert not full.verify(bad)["ok"], f"mutant {i} accepted"
+    assert not net.check_invariants()
+finally:
+    net.close()
+print(f"light client verified {total} live-fleet certificates; "
+      "all mutants rejected")
+EOF
+python - <<'EOF'
+from at2_node_tpu.node.config import FinalityConfig
+from at2_node_tpu.sim.campaign import run_episode
+
+kw = dict(n_events=10, duration=8.0, settle_horizon=60.0)
+plain = run_episode(13, **kw)
+tabled = run_episode(
+    13, config_overrides={"finality": FinalityConfig()}, **kw
+)
+assert plain.trace_hash == tabled.trace_hash, (
+    f"[finality]-off not byte-identical: {plain.trace_hash[:12]} != "
+    f"{tabled.trace_hash[:12]}"
+)
+print("all-knobs-off [finality] table is wire-invisible:",
+      plain.trace_hash[:16])
+EOF
+
 echo "== bench-regression sentry gate =="
 # regress.py diffs every banked BENCH_*/SCALE_*/MULTICHIP_* artifact
 # against its nearest COMPARABLE capture (tunnel/device state must

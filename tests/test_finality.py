@@ -1,0 +1,378 @@
+"""Finality-certificate subsystem (finality/, wire kind 16).
+
+Covers the full externally-verifiable evidence chain:
+
+* kind-16 co-signature wire roundtrip (and the native-parser
+  differential when the C++ ingest library is buildable);
+* CertAssembler quorum assembly, counters, the equivocation latch
+  (attribution requires a VALID signature — forged frames cannot
+  implicate a member), and export/restore through the store manifest's
+  JSON seam;
+* the stateless LightVerifier in both subset (f+1 known keys) and
+  full (complete member list) modes, including byte-level mutants and
+  the chain monotonicity rule;
+* config gating: a fleet without the ``[finality]`` table keeps the
+  subsystem fully inert;
+* the sim lane: a finality-enabled episode produces verifiable
+  certificates on every node, hostile certificate frames fuzz through
+  the capture-replay bridge deterministically, and the planted
+  equivocation campaign latches with attribution (slow tier — CI runs
+  it twice via scripts/ci.sh for the determinism half).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+
+import pytest
+
+from at2_node_tpu.broadcast.messages import (
+    CERT_SIG,
+    CERT_SIG_WIRE,
+    CertSig,
+    parse_frame,
+)
+from at2_node_tpu.crypto.keys import SignKeyPair
+from at2_node_tpu.finality import (
+    CertAssembler,
+    Certificate,
+    LightVerifier,
+    verify_chain,
+)
+from at2_node_tpu.finality.light import default_threshold
+from at2_node_tpu.native import ingest_available
+
+
+def _keypairs(n: int, seed: int = 0):
+    rng = random.Random(seed)
+    return [
+        SignKeyPair(bytes(rng.getrandbits(8) for _ in range(32)))
+        for _ in range(n)
+    ]
+
+
+def _digests(seed: int = 1):
+    rng = random.Random(seed)
+    wm = bytes(rng.getrandbits(8) for _ in range(16))
+    ranges = bytes(rng.getrandbits(8) for _ in range(128))
+    dird = bytes(rng.getrandbits(8) for _ in range(8))
+    return wm, ranges, dird
+
+
+def _assemble(kps, *, epoch: int = 0, seed: int = 1):
+    """Run every keypair's co-signature through a fresh assembler."""
+    asm = CertAssembler([kp.public for kp in kps], epoch=epoch)
+    wm, ranges, dird = _digests(seed)
+    cert = None
+    for i, kp in enumerate(kps):
+        got = asm.add(CertSig.create(kp, epoch, 50 + i, wm, ranges, dird))
+        cert = got or cert
+    assert cert is not None
+    return asm, cert
+
+
+# -- wire ----------------------------------------------------------------
+
+
+def test_cert_sig_wire_roundtrip():
+    kp = _keypairs(1)[0]
+    wm, ranges, dird = _digests()
+    cosig = CertSig.create(kp, 3, 1234, wm, ranges, dird)
+    frame = cosig.encode()
+    assert len(frame) == CERT_SIG_WIRE
+    assert frame[0] == CERT_SIG
+    (back,) = parse_frame(frame)
+    assert back == cosig
+    # commits rides OUTSIDE the signed preimage (node-local coordinate)
+    other = CertSig.create(kp, 3, 9999, wm, ranges, dird)
+    assert other.signature == cosig.signature
+    # epoch/wm/ranges/dir are all inside it
+    assert CertSig.create(kp, 4, 1234, wm, ranges, dird).signature != (
+        cosig.signature
+    )
+
+
+def test_certificate_roundtrip():
+    kps = _keypairs(4)
+    _, cert = _assemble(kps)
+    raw = cert.encode()
+    assert Certificate.decode(raw) == cert
+    # the manifest seam is JSON: to_doc must survive dumps/loads
+    doc = json.loads(json.dumps(cert.to_doc()))
+    assert Certificate.from_doc(doc) == cert
+    assert cert.signer_count() >= 3  # 2f+1 of 4
+
+
+@pytest.mark.skipif(
+    not ingest_available(), reason="native ingest library unavailable"
+)
+def test_native_parse_differential_kind16():
+    from at2_node_tpu.native import parse_frames_native
+    from at2_node_tpu.sim.hostile import mutate_cert_frame
+
+    kp = _keypairs(1)[0]
+    wm, ranges, dird = _digests()
+    good = CertSig.create(kp, 0, 7, wm, ranges, dird).encode()
+    rng = random.Random(11)
+    frames = [good] + [mutate_cert_frame(good, rng) for _ in range(32)]
+    native, frame_ok = parse_frames_native(frames)
+    for fi, ok in enumerate(frame_ok):
+        try:
+            py = parse_frame(frames[fi])
+            py_ok = True
+        except Exception:
+            py, py_ok = [], False
+        assert bool(ok) == py_ok, f"frame {fi}: native {ok} != python"
+        if py_ok:
+            got = [msg for gi, msg in native if gi == fi]
+            assert got == py, f"frame {fi}: parse mismatch"
+
+
+# -- assembler -----------------------------------------------------------
+
+
+def test_assembler_quorum_counters_and_duplicates():
+    kps = _keypairs(4)
+    asm = CertAssembler([kp.public for kp in kps])
+    assert asm.quorum == 3  # 2f+1, f = (4-1)//3
+    wm, ranges, dird = _digests()
+    sigs = [CertSig.create(kp, 0, 10 + i, wm, ranges, dird)
+            for i, kp in enumerate(kps)]
+    assert asm.add(sigs[0]) is None
+    assert asm.add(sigs[0]) is None  # duplicate
+    assert asm.add(sigs[1]) is None
+    cert = asm.add(sigs[2])  # third distinct signer => quorum
+    assert cert is not None and cert.signer_count() == 3
+    assert asm.add(sigs[3]) is None  # late cosig, cert already out
+    assert asm.counters["duplicates"] == 1
+    assert asm.counters["assembled"] == 1
+    # non-member cosig
+    outsider = SignKeyPair(bytes(range(32)))
+    asm.add(CertSig.create(outsider, 0, 1, wm, ranges, dird))
+    assert asm.counters["foreign"] == 1
+    # stale epoch
+    asm.add(CertSig.create(kps[0], 9, 1, wm, ranges, dird))
+    assert asm.counters["epoch_skew"] == 1
+    # forged signature
+    bad = dataclasses.replace(sigs[3], signature=bytes(64))
+    asm.add(bad)
+    assert asm.counters["bad_sig"] == 1
+    assert asm.latest == cert
+
+
+def test_equivocation_latch_requires_valid_signature():
+    kps = _keypairs(4)
+    asm = CertAssembler([kp.public for kp in kps])
+    wm, ranges, dird = _digests()
+    first = CertSig.create(kps[0], 0, 5, wm, ranges, dird)
+    asm.add(first)
+    # a FORGED conflicting cosig must not implicate the member
+    conflicting = CertSig.create(
+        kps[0], 0, 5, wm, bytes(x ^ 0xFF for x in ranges), dird
+    )
+    forged = dataclasses.replace(conflicting, signature=bytes(64))
+    asm.add(forged)
+    assert asm.equivocation is None
+    assert asm.counters["bad_sig"] == 1
+    # the genuinely signed conflict latches with attribution
+    asm.add(conflicting)
+    eq = asm.equivocation
+    assert eq is not None
+    assert eq["origin"] == kps[0].public.hex()
+    assert eq["first"]["ranges"] != eq["second"]["ranges"]
+    # the latch never self-clears, even across later clean quorums
+    wm2, ranges2, dird2 = _digests(seed=2)
+    for i, kp in enumerate(kps):
+        asm.add(CertSig.create(kp, 0, 20 + i, wm2, ranges2, dird2))
+    assert asm.latest is not None
+    assert asm.equivocation is not None
+
+
+def test_assembler_export_restore_roundtrip():
+    kps = _keypairs(4)
+    asm, cert = _assemble(kps)
+    # plant a latched equivocation so the evidence survives too
+    wm, ranges, dird = _digests(seed=3)
+    asm.add(CertSig.create(kps[1], 0, 1, wm, ranges, dird))
+    asm.add(CertSig.create(kps[1], 0, 1, wm, bytes(128), dird))
+    assert asm.equivocation is not None
+    # the store manifest is JSON — exported state must survive the trip
+    doc = json.loads(json.dumps(asm.export()))
+    fresh = CertAssembler([kp.public for kp in kps])
+    fresh.restore(doc)
+    assert fresh.latest == cert
+    assert fresh.chain == asm.chain
+    assert fresh.equivocation == asm.equivocation
+    # counters are runtime telemetry, deliberately NOT persisted
+    assert fresh.counters["assembled"] == 0
+
+
+# -- light client --------------------------------------------------------
+
+
+def test_light_verifier_subset_full_and_mutants():
+    kps = _keypairs(4)
+    _, cert = _assemble(kps)
+    keys = [kp.public for kp in kps]
+    need = default_threshold(4)
+    assert need == 2  # f+1 of 4
+    subset = LightVerifier(keys[:need], total=4)
+    full = LightVerifier([], members=keys)
+    for verifier in (subset, full):
+        got = verifier.verify(cert)
+        assert got["ok"], got
+    # preimage mutations kill every co-signature: both modes reject
+    preimage_mutants = [
+        dataclasses.replace(cert, ranges=bytes(x ^ 0xFF
+                                               for x in cert.ranges)),
+        dataclasses.replace(cert, wm_digest=bytes(16)),
+        dataclasses.replace(cert, epoch=cert.epoch + 1),
+    ]
+    for i, bad in enumerate(preimage_mutants):
+        for verifier in (subset, full):
+            assert not verifier.verify(bad)["ok"], f"mutant {i} accepted"
+    # structural mutations (bitmap bits, sig-blob shape) are full mode's
+    # job — subset mode matches trusted keys against the blob directly
+    # and by design never reads the bitmap
+    structural_mutants = [
+        dataclasses.replace(
+            cert,
+            bitmap=bytes([cert.bitmap[0] ^ 0x0F]) + cert.bitmap[1:],
+        ),
+        dataclasses.replace(cert, sigs=cert.sigs[:-64]),
+        dataclasses.replace(cert, sigs=cert.sigs[64:] + cert.sigs[:64]),
+    ]
+    for i, bad in enumerate(structural_mutants):
+        assert not full.verify(bad)["ok"], f"structural mutant {i} accepted"
+
+
+def test_verify_chain_monotonicity():
+    kps = _keypairs(4)
+    _, c1 = _assemble(kps, seed=1)
+    asm2 = CertAssembler([kp.public for kp in kps])
+    wm, ranges, dird = _digests(seed=2)
+    c2 = None
+    for i, kp in enumerate(kps):
+        got = asm2.add(CertSig.create(kp, 0, 200 + i, wm, ranges, dird))
+        c2 = got or c2
+    assert c2 is not None and c2.commits > c1.commits
+    full = LightVerifier([], members=[kp.public for kp in kps])
+    assert verify_chain([c1, c2], full)["ok"]
+    # a regressing commit frontier is not a valid chain
+    back = verify_chain([c2, c1], full)
+    assert not back["ok"] and back["index"] == 1
+
+
+# -- config gating + sim lane --------------------------------------------
+
+
+def test_finality_disabled_is_inert():
+    from at2_node_tpu.sim.net import SimNet
+
+    net = SimNet(3, 0, 5).start()
+    try:
+        for svc in net.services:
+            assert svc.certs is None
+            assert svc._finality_status() == {"enabled": False}
+    finally:
+        net.close()
+
+
+def test_sim_fleet_produces_verifiable_chain():
+    from at2_node_tpu.node.config import FinalityConfig, ObservabilityConfig
+    from at2_node_tpu.sim.net import SimNet, sim_client, sim_keypairs
+
+    seed, nodes = 7, 4
+    net = SimNet(
+        nodes, 1, seed,
+        finality=FinalityConfig(enabled=True),
+        observability=ObservabilityConfig(audit_every=8),
+    ).start()
+    try:
+        client = sim_client(seed, 0)
+        recipient = sim_client(seed, 1).public
+        for k in range(16):
+            net.submit(k % nodes, client, k + 1, recipient, 1)
+        net.settle(horizon=60.0)
+        for svc in net.services:
+            svc._emit_beacon()
+        net.settle(horizon=10.0)
+        keys = [sim_keypairs(seed, i)[0].public for i in range(nodes)]
+        # stateless client: all genesis keys known, f+1 valid co-signers
+        # required (a 2f+1 cert only guarantees ONE overlap with an
+        # arbitrary f+1 key subset — the signer set is arrival-order)
+        subset = LightVerifier(keys, total=nodes)
+        assert subset.threshold == default_threshold(nodes)
+        for svc in net.services:
+            chain = list(svc.certs.chain)
+            assert chain, svc.certs.status()
+            assert verify_chain(chain, subset)["ok"]
+            assert svc.certs.equivocation is None
+        assert not net.check_invariants()
+    finally:
+        net.close()
+
+
+def test_capture_replay_fuzzes_kind16_frames():
+    """Hostile certificate frames ride the capture→replay bridge like
+    any other wire kind: a synthetic capture of valid + mutated kind-16
+    frames must replay to the same verdict hash twice, crash-free."""
+    from at2_node_tpu.sim.hostile import mutate_cert_frame
+    from at2_node_tpu.tools.capture_replay import replay_capture, verdict_hash
+
+    kp = _keypairs(1)[0]
+    wm, ranges, dird = _digests()
+    good = CertSig.create(kp, 0, 7, wm, ranges, dird).encode()
+    rng = random.Random(23)
+    records = []
+    for i in range(24):
+        frame = good if i % 4 == 0 else mutate_cert_frame(good, rng)
+        records.append([i * 5_000_000, "fuzz", CERT_SIG, frame.hex()])
+    doc = {"cap": 256, "captured": len(records), "records": records}
+    v1 = replay_capture(doc, 9)
+    v2 = replay_capture(doc, 9)
+    assert verdict_hash(v1) == verdict_hash(v2)
+    assert not v1["violations"], v1["violations"]
+
+
+def test_mutate_cert_frame_deterministic():
+    from at2_node_tpu.sim.hostile import mutate_cert_frame
+
+    kp = _keypairs(1)[0]
+    wm, ranges, dird = _digests()
+    good = CertSig.create(kp, 0, 7, wm, ranges, dird).encode()
+    a = [mutate_cert_frame(good, random.Random(3)) for _ in range(16)]
+    b = [mutate_cert_frame(good, random.Random(3)) for _ in range(16)]
+    assert a == b
+    assert all(m != good for m in a)
+
+
+def test_generate_cert_events_shape():
+    from at2_node_tpu.sim.campaign import generate_cert_events
+
+    events = generate_cert_events(random.Random(1), n_events=20)
+    kinds = [e[1] for e in events]
+    assert kinds.count("cert_equiv") == 3
+    assert kinds.count("cert_stale") == 2
+    assert kinds.count("cert_forge") == 2
+    assert [e[0] for e in events] == sorted(e[0] for e in events)
+    assert generate_cert_events(random.Random(1), n_events=20) == events
+
+
+@pytest.mark.slow
+def test_planted_cert_equivocation_episode():
+    from at2_node_tpu.sim.campaign import planted_cert_equivocation_episode
+    from at2_node_tpu.sim.net import sim_keypairs
+
+    seed = 20260807
+    r = planted_cert_equivocation_episode(seed)
+    assert not r.violations, r.violations
+    culprit = sim_keypairs(seed, 4)[0].public.hex()
+    assert r.audit is not None
+    for a in r.audit:
+        fin = a["finality"]
+        assert fin is not None and fin["chain_len"] > 0, fin
+        assert fin["equivocation"]["origin"] == culprit
+        assert fin["epoch_skew"] > 0 and fin["bad_sig"] > 0, fin
